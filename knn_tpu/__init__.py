@@ -1,0 +1,49 @@
+"""knn_tpu — a TPU-native distributed brute-force KNN framework.
+
+Re-implements the capabilities of the reference C++/MPI program
+(``knn_mpi.cpp``, 398 LoC: brute-force KNN classification with L2/L1
+distances, distributed min-max normalization, top-K majority vote, CSV
+in/out, validation accuracy) as an idiomatic JAX/XLA framework:
+
+- distances as batched matmuls on the MXU (``ops.distance``),
+- neighbor selection via ``lax.top_k`` with tiled streaming merges
+  (``ops.topk``),
+- the reference's MPI collectives (Bcast/Scatter/Allreduce/Gather,
+  knn_mpi.cpp:224-227,276-277,340,383) as sharding + XLA collectives over a
+  device mesh (``parallel``),
+- a native C++ CPU backend as the parity oracle (``native``).
+
+Layer map (mirrors SURVEY.md §1):
+  L0 communication  -> knn_tpu.parallel
+  L1 data / IO      -> knn_tpu.data
+  L2 preprocessing  -> knn_tpu.ops.normalize
+  L3 compute core   -> knn_tpu.ops.{distance,topk,vote}
+  L4 eval / driver  -> knn_tpu.models, knn_tpu.pipeline, knn_tpu.cli
+  L5 config         -> knn_tpu.utils.config
+"""
+
+from knn_tpu.ops.distance import pairwise_distance, pairwise_sq_l2, pairwise_l1, pairwise_cosine
+from knn_tpu.ops.topk import topk_smallest, merge_topk, knn_search, knn_search_tiled
+from knn_tpu.ops.vote import majority_vote
+from knn_tpu.ops.normalize import minmax_stats, minmax_apply, normalize_transductive
+from knn_tpu.models.classifier import KNNClassifier, knn_predict
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "pairwise_distance",
+    "pairwise_sq_l2",
+    "pairwise_l1",
+    "pairwise_cosine",
+    "topk_smallest",
+    "merge_topk",
+    "knn_search",
+    "knn_search_tiled",
+    "majority_vote",
+    "minmax_stats",
+    "minmax_apply",
+    "normalize_transductive",
+    "KNNClassifier",
+    "knn_predict",
+    "__version__",
+]

@@ -1,0 +1,6 @@
+"""L4 model layer: user-facing KNN estimators built on the L3 ops."""
+
+from knn_tpu.models.classifier import KNNClassifier, knn_predict
+from knn_tpu.models.regressor import KNNRegressor
+
+__all__ = ["KNNClassifier", "knn_predict", "KNNRegressor"]

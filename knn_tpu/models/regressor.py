@@ -1,0 +1,86 @@
+"""KNN regressor: mean / inverse-distance-weighted target over the k nearest
+neighbors.  Not in the reference (which only classifies) — a natural
+capability extension sharing the same L3 ops."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from knn_tpu.ops.topk import knn_search_tiled
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "weights", "train_tile", "compute_dtype")
+)
+def knn_regress(
+    train: jax.Array,
+    train_targets: jax.Array,
+    queries: jax.Array,
+    *,
+    k: int,
+    metric: str = "l2",
+    weights: str = "uniform",
+    train_tile: Optional[int] = None,
+    compute_dtype=None,
+) -> jax.Array:
+    dists, idx = knn_search_tiled(
+        queries, train, k, metric, train_tile=train_tile, compute_dtype=compute_dtype
+    )
+    targets = train_targets[idx].astype(jnp.float32)  # [Q, k] or [Q, k, out]
+    if weights == "uniform":
+        return jnp.mean(targets, axis=1)
+    if weights == "distance":
+        w = 1.0 / jnp.maximum(dists, 1e-12)  # [Q, k]
+        w = w / jnp.sum(w, axis=1, keepdims=True)
+        if targets.ndim == 3:
+            w = w[..., None]
+        return jnp.sum(w * targets, axis=1)
+    raise ValueError(f"unknown weights {weights!r}")
+
+
+class KNNRegressor:
+    """fit/predict regressor over the same tiled KNN core as the classifier."""
+
+    def __init__(
+        self,
+        k: int = 5,
+        metric: str = "l2",
+        weights: str = "uniform",
+        train_tile: Optional[int] = None,
+        compute_dtype=None,
+    ):
+        self.k = k
+        self.metric = metric
+        self.weights = weights
+        self.train_tile = train_tile
+        self.compute_dtype = compute_dtype
+        self._train = None
+        self._targets = None
+
+    def fit(self, X, y) -> "KNNRegressor":
+        X = jnp.asarray(X)
+        y = jnp.asarray(y, dtype=jnp.float32)
+        if X.shape[0] != y.shape[0]:
+            raise ValueError(f"bad shapes: X {X.shape}, y {y.shape}")
+        if self.k > X.shape[0]:
+            raise ValueError(f"k={self.k} > n_train={X.shape[0]}")
+        self._train, self._targets = X, y
+        return self
+
+    def predict(self, Q) -> jax.Array:
+        if self._train is None:
+            raise RuntimeError("call fit() first")
+        return knn_regress(
+            self._train,
+            self._targets,
+            jnp.asarray(Q),
+            k=self.k,
+            metric=self.metric,
+            weights=self.weights,
+            train_tile=self.train_tile,
+            compute_dtype=self.compute_dtype,
+        )

@@ -1,0 +1,1 @@
+"""L3 compute core: distance, top-k selection, majority vote, normalization."""

@@ -1,0 +1,77 @@
+"""Min-max normalization, including the reference's distributed/transductive
+variant (L2 layer, knn_mpi.cpp:229-306).
+
+Reference semantics preserved:
+- Extrema are computed over **train ∪ test ∪ val jointly** (transductive —
+  test data influences train scaling; knn_mpi.cpp:245-274, SURVEY.md §2.5).
+- Constant dimensions (max == min) are left **untouched**, not zeroed
+  (the ``max-min != 0`` guard at knn_mpi.cpp:284,292,302).
+
+Reference bug fixed: extrema accumulators init to ±inf, not the reference's
+``max=-1, min=999999`` (knn_mpi.cpp:241-242), which is wrong for negative
+data or values > 999999.
+
+The distributed version maps the reference's two ``MPI_Allreduce`` calls
+(MPI_MAX / MPI_MIN over dim-length vectors, knn_mpi.cpp:276-277) to
+``lax.pmax`` / ``lax.pmin`` over a mesh axis — see
+:func:`local_minmax` + :mod:`knn_tpu.parallel.collectives`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def local_minmax(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-dimension (min, max) over the rows of x [N, D].
+
+    On empty input returns (+inf, -inf) — the identity for a subsequent
+    min/max reduce, so ragged shards combine correctly.
+    """
+    if x.shape[0] == 0:
+        d = x.shape[-1]
+        return (
+            jnp.full((d,), jnp.inf, dtype=jnp.float32),
+            jnp.full((d,), -jnp.inf, dtype=jnp.float32),
+        )
+    x32 = x.astype(jnp.float32)
+    return jnp.min(x32, axis=0), jnp.max(x32, axis=0)
+
+
+def minmax_stats(arrays: Iterable[jax.Array]) -> Tuple[jax.Array, jax.Array]:
+    """Joint per-dim (min, max) over several row-major [N_i, D] arrays —
+    the transductive train∪test∪val extrema of knn_mpi.cpp:245-274."""
+    mins, maxs = None, None
+    for a in arrays:
+        lo, hi = local_minmax(a)
+        mins = lo if mins is None else jnp.minimum(mins, lo)
+        maxs = hi if maxs is None else jnp.maximum(maxs, hi)
+    if mins is None:
+        raise ValueError("minmax_stats needs at least one array")
+    return mins, maxs
+
+
+def minmax_apply(x: jax.Array, mins: jax.Array, maxs: jax.Array) -> jax.Array:
+    """x -> (x - min) / (max - min), constant dims passed through unchanged
+    (the knn_mpi.cpp:284 guard)."""
+    x32 = x.astype(jnp.float32)
+    rng = maxs - mins
+    safe = jnp.where(rng != 0, rng, 1.0)
+    return jnp.where(rng != 0, (x32 - mins) / safe, x32)
+
+
+def normalize_transductive(
+    train: jax.Array,
+    test: Optional[jax.Array] = None,
+    val: Optional[jax.Array] = None,
+) -> Sequence[Optional[jax.Array]]:
+    """Reference L2 phase end-to-end (knn_mpi.cpp:229-306): joint extrema over
+    all provided sets, then rescale each.  Returns (train', test', val') with
+    None passed through."""
+    present = [a for a in (train, test, val) if a is not None]
+    mins, maxs = minmax_stats(present)
+    out = tuple(None if a is None else minmax_apply(a, mins, maxs) for a in (train, test, val))
+    return out

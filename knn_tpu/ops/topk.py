@@ -1,0 +1,146 @@
+"""Top-k neighbor selection: exact ``lax.top_k`` plus a tiled streaming merge.
+
+The reference selects neighbors by fully sorting all N_train candidate
+distances per query with ``std::sort`` (knn_mpi.cpp:323,366) — O(N log N)
+for a top-K=50 select.  The TPU-native replacement is ``lax.top_k`` over the
+distance matrix, and for databases too large to materialize a full |Q|x|T|
+distance matrix in HBM, a ``lax.scan`` over train tiles that carries a
+running top-k (the TPU-KNN-paper-style streaming merge; SURVEY.md §7 step 5).
+
+Tie-breaking: the reference's ``std::sort`` with ``Comp`` (knn_mpi.cpp:24-31)
+leaves the order of equal distances unspecified.  We define it: ties go to
+the **lower train index**.  ``lax.top_k`` documents exactly this (equal
+values -> lower index first), and the tiled merge preserves it because the
+running-best buffer always sits before the new tile in the concatenated
+candidate array and earlier tiles hold smaller indices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from knn_tpu.ops.distance import pairwise_distance
+
+
+def topk_smallest(dists: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """(values, indices) of the k smallest entries along the last axis,
+    sorted ascending; ties broken toward the lower index."""
+    neg, idx = lax.top_k(-dists, k)
+    return -neg, idx
+
+
+def merge_topk(
+    best_d: jax.Array,
+    best_i: jax.Array,
+    new_d: jax.Array,
+    new_i: jax.Array,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge a running top-k with new candidates along the last axis.
+
+    Inputs are [..., k] and [..., m]; output is the combined top-k.
+    ``best`` must precede ``new`` so top_k's lower-position tie-break keeps
+    the lower-train-index-first invariant (see module docstring).
+    """
+    d = jnp.concatenate([best_d, new_d], axis=-1)
+    i = jnp.concatenate([best_i, new_i], axis=-1)
+    md, pos = lax.top_k(-d, k)
+    return -md, jnp.take_along_axis(i, pos, axis=-1)
+
+
+def knn_search(
+    queries: jax.Array,
+    train: jax.Array,
+    k: int,
+    metric: str = "l2",
+    *,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact KNN with the full distance matrix materialized: [Q, k] dists+idx.
+
+    Use when |Q|x|T| fits in HBM; otherwise :func:`knn_search_tiled`.
+    """
+    d = pairwise_distance(queries, train, metric, compute_dtype=compute_dtype)
+    return topk_smallest(d, k)
+
+
+def knn_search_tiled(
+    queries: jax.Array,
+    train: jax.Array,
+    k: int,
+    metric: str = "l2",
+    *,
+    train_tile: Optional[int] = None,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact KNN streaming over train tiles with a running top-k merge.
+
+    HBM cost is O(Q*train_tile) per step instead of O(Q*T).  Handles T not
+    divisible by ``train_tile`` by padding with +inf distances (replacing the
+    reference's divisibility ``MPI_Abort`` at knn_mpi.cpp:127-129 with
+    padding).  Results are identical to :func:`knn_search` including
+    lower-index tie-breaks.
+    """
+    n_train = train.shape[0]
+    if k > n_train:
+        raise ValueError(f"k={k} > n_train={n_train}")
+    if train_tile is None or train_tile >= n_train:
+        return knn_search(queries, train, k, metric, compute_dtype=compute_dtype)
+
+    n_tiles = -(-n_train // train_tile)
+    padded = n_tiles * train_tile
+    if padded != n_train:
+        train = jnp.pad(train, ((0, padded - n_train), (0, 0)))
+    tiles = train.reshape(n_tiles, train_tile, train.shape[-1])
+
+    n_q = queries.shape[0]
+    init_d = jnp.full((n_q, k), jnp.inf, dtype=jnp.float32)
+    init_i = jnp.full((n_q, k), jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+
+    def step(carry, args):
+        best_d, best_i = carry
+        tile_idx, tile = args
+        d = pairwise_distance(queries, tile, metric, compute_dtype=compute_dtype)
+        gidx = tile_idx * train_tile + lax.broadcasted_iota(jnp.int32, (1, train_tile), 1)
+        valid = gidx < n_train
+        d = jnp.where(valid, d, jnp.inf)
+        gidx = jnp.broadcast_to(gidx, d.shape)
+        return merge_topk(best_d, best_i, d, gidx, k), None
+
+    (best_d, best_i), _ = lax.scan(
+        step, (init_d, init_i), (jnp.arange(n_tiles, dtype=jnp.int32), tiles)
+    )
+    return best_d, best_i
+
+
+def knn_search_approx(
+    queries: jax.Array,
+    train: jax.Array,
+    k: int,
+    *,
+    recall_target: float = 0.95,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate L2 KNN via ``lax.approx_max_k`` — the recall-vs-speed knob
+    (SURVEY.md §7 step 6).  L2 only: uses the -||t||^2 + 2 q.t^T MIPS score
+    so approx_max_k's aggregate-to-topk path applies."""
+    t32 = train.astype(jnp.float32)
+    half_t_norm = 0.5 * jnp.sum(t32 * t32, axis=-1)[None, :]
+    if compute_dtype is None:
+        compute_dtype = queries.dtype
+    qt = lax.dot_general(
+        queries.astype(compute_dtype),
+        train.astype(compute_dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    score = qt - half_t_norm  # argmax_t score == argmin_t ||q-t||^2
+    neg_half, idx = lax.approx_max_k(score, k, recall_target=recall_target)
+    q32 = queries.astype(jnp.float32)
+    q_norm = jnp.sum(q32 * q32, axis=-1, keepdims=True)
+    return jnp.maximum(q_norm - 2.0 * neg_half, 0.0), idx

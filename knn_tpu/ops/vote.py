@@ -1,0 +1,55 @@
+"""Top-K majority vote with the reference's exact tie-break semantics.
+
+The reference votes with a per-query class histogram and a *running* argmax
+with strict ``>`` over neighbors visited in ascending-distance order
+(knn_mpi.cpp:324-336 val, :367-379 test): the winner is the first label to
+*reach* the final maximum count.  Equivalently: among labels whose final
+count equals the max, the one whose cumulative count hits the max earliest
+in distance order wins.  That formulation vectorizes: one-hot -> cumsum ->
+first position where a label's cumulative count reaches the global max.
+
+This matters for parity: "fixing" the tie-break silently changes predicted
+labels (SURVEY.md §7 hard part (d)).  Unlike the reference, out-of-range
+labels cannot corrupt memory (knn_mpi.cpp:330 indexes the vote array with an
+unchecked label) — one_hot simply drops them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def majority_vote(neighbor_labels: jax.Array, num_classes: int) -> jax.Array:
+    """Winner label per query.
+
+    Args:
+      neighbor_labels: int array [..., K], neighbors in ascending-distance
+        order (as returned by ops.topk), values in [0, num_classes).
+      num_classes: the reference's ``class_cnt`` (knn_mpi.cpp:113).
+
+    Returns:
+      int32 array [...] of winning labels, reference tie-break semantics.
+    """
+    k = neighbor_labels.shape[-1]
+    onehot = jax.nn.one_hot(neighbor_labels, num_classes, dtype=jnp.int32)  # [..., K, C]
+    counts = jnp.sum(onehot, axis=-2)  # [..., C]
+    max_count = jnp.max(counts, axis=-1, keepdims=True)  # [..., 1]
+
+    cum = jnp.cumsum(onehot, axis=-2)  # [..., K, C]
+    # The step at which a label's count *becomes* the final max: cumulative
+    # count equals max AND this step incremented that label.
+    reach = (cum == max_count[..., None, :]) & (onehot == 1)
+    steps = lax.broadcasted_iota(jnp.int32, reach.shape, reach.ndim - 2)
+    first_reach = jnp.min(jnp.where(reach, steps, k), axis=-2)  # [..., C]
+    # Labels that never reach the max get sentinel k; among reachers the
+    # reach steps are distinct (one increment per step), so argmin is unique.
+    return jnp.argmin(jnp.where(counts == max_count, first_reach, k + 1), axis=-1).astype(
+        jnp.int32
+    )
+
+
+def vote_counts(neighbor_labels: jax.Array, num_classes: int) -> jax.Array:
+    """Class histogram over the K neighbors, [..., num_classes] int32."""
+    return jnp.sum(jax.nn.one_hot(neighbor_labels, num_classes, dtype=jnp.int32), axis=-2)

@@ -1,0 +1,36 @@
+"""Test harness: force an 8-device CPU mesh so every multi-device test runs
+on virtual CPU devices — these play the role MPI ranks play in the reference
+(SURVEY.md §4) — without touching TPU hardware.
+
+Two mechanisms, because the TPU environment may inject a PJRT plugin via
+sitecustomize *before* this file runs (so env vars alone come too late
+there, and config updates alone don't cover fresh subprocesses):
+  1. env vars, for any subprocess the tests spawn;
+  2. ``jax.config.update``, which wins in this process as long as no
+     backend has been initialized yet (JAX initializes them lazily).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # older jax: the XLA_FLAGS path above covers it
+
+import numpy as np
+import pytest
+
+assert len(jax.devices()) >= 8, "test harness requires 8 virtual CPU devices"
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,65 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracles
+from knn_tpu.ops import distance
+
+
+@pytest.fixture
+def qt(rng):
+    q = rng.normal(size=(17, 23)).astype(np.float32)
+    t = rng.normal(size=(31, 23)).astype(np.float32)
+    return q, t
+
+
+def test_sq_l2_matches_oracle(qt):
+    q, t = qt
+    got = np.asarray(distance.pairwise_sq_l2(jnp.asarray(q), jnp.asarray(t)))
+    np.testing.assert_allclose(got, oracles.sq_l2(q, t), rtol=1e-4, atol=1e-4)
+
+
+def test_sq_l2_direct_matches_oracle(qt):
+    q, t = qt
+    got = np.asarray(distance.pairwise_sq_l2_direct(jnp.asarray(q), jnp.asarray(t)))
+    np.testing.assert_allclose(got, oracles.sq_l2(q, t), rtol=1e-5, atol=1e-5)
+
+
+def test_sq_l2_nonnegative(rng):
+    # expanded-square cancellation must be clamped: distance of a point to
+    # itself is exactly the cancellation-prone case
+    x = rng.normal(size=(8, 16)).astype(np.float32) * 100
+    d = np.asarray(distance.pairwise_sq_l2(jnp.asarray(x), jnp.asarray(x)))
+    assert (d >= 0).all()
+    assert np.abs(np.diagonal(d)).max() < 1e-6 * d.max()
+
+
+def test_l1_matches_oracle(qt):
+    q, t = qt
+    got = np.asarray(distance.pairwise_l1(jnp.asarray(q), jnp.asarray(t)))
+    np.testing.assert_allclose(got, oracles.l1(q, t), rtol=1e-5, atol=1e-5)
+
+
+def test_cosine_matches_oracle(qt):
+    q, t = qt
+    got = np.asarray(distance.pairwise_cosine(jnp.asarray(q), jnp.asarray(t)))
+    np.testing.assert_allclose(got, oracles.cosine(q, t), rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_compute_close_to_fp32(qt):
+    q, t = qt
+    ref = oracles.sq_l2(q, t)
+    got = np.asarray(
+        distance.pairwise_sq_l2(jnp.asarray(q), jnp.asarray(t), compute_dtype=jnp.bfloat16)
+    )
+    # bf16 matmul with fp32 accumulate: loose elementwise tolerance
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.5)
+
+
+def test_dispatch_names(qt):
+    q, t = qt
+    for name in ("l2", "euclidean", "sql2", "l1", "manhattan", "cosine", "dot"):
+        d = distance.pairwise_distance(jnp.asarray(q), jnp.asarray(t), name)
+        assert d.shape == (q.shape[0], t.shape[0])
+    with pytest.raises(ValueError):
+        distance.pairwise_distance(jnp.asarray(q), jnp.asarray(t), "hamming")

@@ -1,0 +1,52 @@
+import jax.numpy as jnp
+import numpy as np
+
+import oracles
+from knn_tpu.ops import normalize
+
+
+def test_transductive_matches_oracle(rng):
+    train = rng.normal(size=(20, 6)).astype(np.float32) * 10
+    test = rng.normal(size=(8, 6)).astype(np.float32) * 10
+    val = rng.normal(size=(5, 6)).astype(np.float32) * 10
+    got = normalize.normalize_transductive(
+        jnp.asarray(train), jnp.asarray(test), jnp.asarray(val)
+    )
+    ref = oracles.minmax_normalize_transductive(train, test, val)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(np.asarray(g), r, rtol=1e-5, atol=1e-6)
+
+
+def test_constant_dim_untouched(rng):
+    # knn_mpi.cpp:284 guard: max==min dims pass through unchanged
+    x = rng.normal(size=(10, 3)).astype(np.float32)
+    x[:, 1] = 42.0
+    (out, _, _) = normalize.normalize_transductive(jnp.asarray(x))
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:, 1], x[:, 1])
+    assert out[:, 0].min() == 0.0 and out[:, 0].max() == 1.0
+
+
+def test_negative_data_handled(rng):
+    # the reference's max=-1/min=999999 init (knn_mpi.cpp:241-242) breaks on
+    # negative data; ours must not
+    x = (rng.normal(size=(30, 4)) * 1e6 - 5e5).astype(np.float32)
+    (out, _, _) = normalize.normalize_transductive(jnp.asarray(x))
+    out = np.asarray(out)
+    assert np.nanmin(out) >= 0.0 and np.nanmax(out) <= 1.0
+
+
+def test_transductive_extrema_include_test(rng):
+    train = np.zeros((4, 2), dtype=np.float32)
+    train[:, 0] = [0, 1, 2, 3]
+    train[:, 1] = [0, 1, 2, 3]
+    test = np.asarray([[10.0, -10.0]], dtype=np.float32)
+    tr, te, _ = normalize.normalize_transductive(jnp.asarray(train), jnp.asarray(test))
+    # train scaled by extrema that include the test outlier
+    np.testing.assert_allclose(np.asarray(tr)[:, 0], np.asarray([0, 1, 2, 3]) / 10.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(te)[0, 0], 1.0)
+
+
+def test_empty_shard_identity(rng):
+    lo, hi = normalize.local_minmax(jnp.zeros((0, 5)))
+    assert np.all(np.isposinf(np.asarray(lo))) and np.all(np.isneginf(np.asarray(hi)))

@@ -1,0 +1,67 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import oracles
+from knn_tpu.ops import topk
+
+
+def test_topk_smallest_sorted_and_lowindex_ties(rng):
+    d = rng.integers(0, 5, size=(6, 40)).astype(np.float32)  # many ties
+    vals, idx = topk.topk_smallest(jnp.asarray(d), 7)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    ref_vals, ref_idx = oracles.topk_lowindex(d, 7)
+    np.testing.assert_array_equal(vals, ref_vals)
+    np.testing.assert_array_equal(idx, ref_idx)
+
+
+def test_knn_search_matches_oracle(rng):
+    q = rng.normal(size=(9, 12)).astype(np.float32)
+    t = rng.normal(size=(50, 12)).astype(np.float32)
+    d_ref, i_ref = oracles.topk_lowindex(oracles.sq_l2(q, t), 5)
+    d, i = topk.knn_search(jnp.asarray(q), jnp.asarray(t), 5)
+    np.testing.assert_array_equal(np.asarray(i), i_ref)
+    np.testing.assert_allclose(np.asarray(d), d_ref, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("tile", [7, 16, 50, 64])
+@pytest.mark.parametrize("metric", ["l2", "l1"])
+def test_tiled_equals_untiled(rng, tile, metric):
+    q = rng.normal(size=(9, 12)).astype(np.float32)
+    t = rng.normal(size=(50, 12)).astype(np.float32)
+    d0, i0 = topk.knn_search(jnp.asarray(q), jnp.asarray(t), 6, metric)
+    d1, i1 = topk.knn_search_tiled(jnp.asarray(q), jnp.asarray(t), 6, metric, train_tile=tile)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-4, atol=1e-4)
+
+
+def test_tiled_tie_break_lowindex(rng):
+    # duplicate train rows across tile boundaries: ties must resolve to the
+    # lower train index even when the duplicate lives in a later tile
+    base = rng.normal(size=(10, 8)).astype(np.float32)
+    t = np.concatenate([base, base, base], axis=0)  # indices i, i+10, i+20 equal
+    q = base[:4] + 0.0
+    _, idx = topk.knn_search_tiled(jnp.asarray(q), jnp.asarray(t), 3, train_tile=7)
+    idx = np.asarray(idx)
+    d_ref, i_ref = oracles.topk_lowindex(oracles.sq_l2(q, t), 3)
+    np.testing.assert_array_equal(idx, i_ref)
+
+
+def test_k_larger_than_train_raises(rng):
+    q = jnp.asarray(rng.normal(size=(2, 4)).astype(np.float32))
+    t = jnp.asarray(rng.normal(size=(3, 4)).astype(np.float32))
+    with pytest.raises(ValueError):
+        topk.knn_search_tiled(q, t, 5, train_tile=2)
+
+
+def test_approx_recall(rng):
+    q = rng.normal(size=(16, 32)).astype(np.float32)
+    t = rng.normal(size=(2048, 32)).astype(np.float32)
+    k = 10
+    _, exact = topk.knn_search(jnp.asarray(q), jnp.asarray(t), k)
+    _, approx = topk.knn_search_approx(jnp.asarray(q), jnp.asarray(t), k, recall_target=0.95)
+    exact, approx = np.asarray(exact), np.asarray(approx)
+    recall = np.mean(
+        [len(set(exact[i]) & set(approx[i])) / k for i in range(q.shape[0])]
+    )
+    assert recall >= 0.8

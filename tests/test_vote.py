@@ -1,0 +1,43 @@
+import jax.numpy as jnp
+import numpy as np
+
+import oracles
+from knn_tpu.ops import vote
+
+
+def test_simple_majority():
+    labels = jnp.asarray([[1, 1, 2], [0, 2, 2], [3, 3, 3]])
+    got = np.asarray(vote.majority_vote(labels, 4))
+    np.testing.assert_array_equal(got, [1, 2, 3])
+
+
+def test_tie_goes_to_first_reacher():
+    # counts tie 2-2; label 5 reaches count 2 at position 2, label 1 at
+    # position 3 -> 5 wins (reference running-argmax semantics)
+    labels = jnp.asarray([[5, 1, 5, 1]])
+    assert int(vote.majority_vote(labels, 6)[0]) == 5
+    # reversed arrival order flips the winner
+    labels = jnp.asarray([[1, 5, 1, 5]])
+    assert int(vote.majority_vote(labels, 6)[0]) == 1
+
+
+def test_matches_reference_loop_oracle(rng):
+    labels = rng.integers(0, 7, size=(200, 15))
+    got = np.asarray(vote.majority_vote(jnp.asarray(labels), 7))
+    ref = oracles.running_argmax_vote(labels, 7)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_batched_shapes(rng):
+    labels = rng.integers(0, 4, size=(3, 5, 9))
+    got = vote.majority_vote(jnp.asarray(labels), 4)
+    assert got.shape == (3, 5)
+    flat = np.asarray(vote.majority_vote(jnp.asarray(labels.reshape(15, 9)), 4))
+    np.testing.assert_array_equal(np.asarray(got).reshape(-1), flat)
+
+
+def test_vote_counts(rng):
+    labels = rng.integers(0, 5, size=(10, 20))
+    counts = np.asarray(vote.vote_counts(jnp.asarray(labels), 5))
+    for i in range(10):
+        np.testing.assert_array_equal(counts[i], np.bincount(labels[i], minlength=5))
