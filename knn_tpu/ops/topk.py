@@ -9,10 +9,13 @@ running top-k (the TPU-KNN-paper-style streaming merge; SURVEY.md §7 step 5).
 
 Tie-breaking: the reference's ``std::sort`` with ``Comp`` (knn_mpi.cpp:24-31)
 leaves the order of equal distances unspecified.  We define it: ties go to
-the **lower train index**.  ``lax.top_k`` documents exactly this (equal
-values -> lower index first), and the tiled merge preserves it because the
-running-best buffer always sits before the new tile in the concatenated
-candidate array and earlier tiles hold smaller indices.
+the **lower train index** — i.e. the k-nearest set is the lexicographic
+smallest k pairs ``(distance, index)``.  ``lax.top_k`` over an index-ordered
+distance row produces exactly this, and :func:`merge_topk` preserves it by
+merging with a two-key ``lax.sort`` over ``(distance, index)``.  Because the
+lexicographic merge is associative and commutative, every execution
+strategy — single-shot, tiled scan, all-gather merge, ring merge across a
+device mesh (parallel.sharded) — returns the identical result.
 """
 
 from __future__ import annotations
@@ -34,6 +37,15 @@ def topk_smallest(dists: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     return -neg, idx
 
 
+def topk_pairs(d: jax.Array, i: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Lexicographic-smallest k ``(distance, index)`` pairs along the last
+    axis, sorted ascending.  A two-key ``lax.sort`` — value ties resolve to
+    the lower index by construction, not by input position, so the result
+    is independent of candidate order."""
+    sd, si = lax.sort((d, i), dimension=-1, num_keys=2)
+    return sd[..., :k], si[..., :k]
+
+
 def merge_topk(
     best_d: jax.Array,
     best_i: jax.Array,
@@ -43,14 +55,13 @@ def merge_topk(
 ) -> Tuple[jax.Array, jax.Array]:
     """Merge a running top-k with new candidates along the last axis.
 
-    Inputs are [..., k] and [..., m]; output is the combined top-k.
-    ``best`` must precede ``new`` so top_k's lower-position tie-break keeps
-    the lower-train-index-first invariant (see module docstring).
+    Inputs are [..., k] and [..., m]; output is the combined lexicographic
+    top-k.  Associative and commutative (see module docstring), so tiled,
+    ring, and all-gather merges all agree bitwise.
     """
     d = jnp.concatenate([best_d, new_d], axis=-1)
     i = jnp.concatenate([best_i, new_i], axis=-1)
-    md, pos = lax.top_k(-d, k)
-    return -md, jnp.take_along_axis(i, pos, axis=-1)
+    return topk_pairs(d, i, k)
 
 
 def knn_search(
@@ -60,12 +71,20 @@ def knn_search(
     metric: str = "l2",
     *,
     compute_dtype=None,
+    n_valid=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact KNN with the full distance matrix materialized: [Q, k] dists+idx.
 
     Use when |Q|x|T| fits in HBM; otherwise :func:`knn_search_tiled`.
+    ``n_valid`` (may be traced): train rows at index >= n_valid are padding —
+    their distance is forced to +inf *before* selection so they can never
+    displace a real neighbor (the db-shard padding contract of
+    parallel.sharded).
     """
     d = pairwise_distance(queries, train, metric, compute_dtype=compute_dtype)
+    if n_valid is not None:
+        cols = lax.broadcasted_iota(jnp.int32, (1, train.shape[0]), 1)
+        d = jnp.where(cols < n_valid, d, jnp.inf)
     return topk_smallest(d, k)
 
 
@@ -77,20 +96,25 @@ def knn_search_tiled(
     *,
     train_tile: Optional[int] = None,
     compute_dtype=None,
+    n_valid=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact KNN streaming over train tiles with a running top-k merge.
 
     HBM cost is O(Q*train_tile) per step instead of O(Q*T).  Handles T not
     divisible by ``train_tile`` by padding with +inf distances (replacing the
     reference's divisibility ``MPI_Abort`` at knn_mpi.cpp:127-129 with
-    padding).  Results are identical to :func:`knn_search` including
-    lower-index tie-breaks.
+    padding).  ``n_valid`` additionally marks trailing train rows as padding
+    (see :func:`knn_search`).  Results are identical to :func:`knn_search`
+    including lower-index tie-breaks.
     """
     n_train = train.shape[0]
     if k > n_train:
         raise ValueError(f"k={k} > n_train={n_train}")
     if train_tile is None or train_tile >= n_train:
-        return knn_search(queries, train, k, metric, compute_dtype=compute_dtype)
+        return knn_search(
+            queries, train, k, metric, compute_dtype=compute_dtype, n_valid=n_valid
+        )
+    limit = n_train if n_valid is None else jnp.minimum(n_train, n_valid)
 
     n_tiles = -(-n_train // train_tile)
     padded = n_tiles * train_tile
@@ -107,7 +131,7 @@ def knn_search_tiled(
         tile_idx, tile = args
         d = pairwise_distance(queries, tile, metric, compute_dtype=compute_dtype)
         gidx = tile_idx * train_tile + lax.broadcasted_iota(jnp.int32, (1, train_tile), 1)
-        valid = gidx < n_train
+        valid = gidx < limit
         d = jnp.where(valid, d, jnp.inf)
         gidx = jnp.broadcast_to(gidx, d.shape)
         return merge_topk(best_d, best_i, d, gidx, k), None

@@ -1,0 +1,52 @@
+"""L0 communication runtime: the reference's MPI backend (knn_mpi.cpp:123-129,
+133-134,224-227,276-277,340,383,395-397 — the 11 entry points in SURVEY.md
+§2.8) rebuilt as sharding + XLA collectives over a `jax.sharding.Mesh`.
+
+Mapping (rank ↔ mesh device):
+  MPI_Bcast      -> replicated NamedSharding            (collectives.replicate)
+  MPI_Scatter    -> sharded NamedSharding / shard_map   (collectives.shard)
+  MPI_Allreduce  -> lax.pmin / lax.pmax / lax.psum      (collectives.allreduce_*)
+  MPI_Gather     -> lax.all_gather / host fetch         (collectives.gather)
+  MPI_Barrier    -> block_until_ready                   (collectives.barrier)
+  MPI_Comm_rank  -> lax.axis_index                      (inside shard_map)
+  MPI_Comm_size  -> mesh.shape[axis]
+  MPI_Abort      -> pad-to-multiple instead             (mesh.pad_to_multiple)
+"""
+
+from knn_tpu.parallel.mesh import (
+    make_mesh,
+    default_mesh,
+    pad_to_multiple,
+    QUERY_AXIS,
+    DB_AXIS,
+)
+from knn_tpu.parallel.collectives import (
+    replicate,
+    shard,
+    allreduce_min,
+    allreduce_max,
+    barrier,
+)
+from knn_tpu.parallel.sharded import (
+    sharded_knn,
+    sharded_knn_predict,
+    sharded_minmax,
+    sharded_normalize_transductive,
+)
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "pad_to_multiple",
+    "QUERY_AXIS",
+    "DB_AXIS",
+    "replicate",
+    "shard",
+    "allreduce_min",
+    "allreduce_max",
+    "barrier",
+    "sharded_knn",
+    "sharded_knn_predict",
+    "sharded_minmax",
+    "sharded_normalize_transductive",
+]

@@ -1,0 +1,77 @@
+"""Device-mesh construction and shape utilities.
+
+The reference's process grid is a flat `MPI_COMM_WORLD` of N ranks
+(knn_mpi.cpp:123-125) used for exactly one thing: sharding queries.  The TPU
+mesh is 2-D from the start, because the framework shards **two** axes the
+reference never could:
+
+  - ``query`` axis: data parallelism over query rows — the direct analogue
+    of the reference's `MPI_Scatter` of test/val shards (knn_mpi.cpp:226-227).
+  - ``db`` axis: sharding of the train/database rows — the axis the
+    reference *replicates* via `MPI_Bcast` (knn_mpi.cpp:224-225); sharding it
+    is the KNN analogue of ring-attention/sequence parallelism (SURVEY.md §5)
+    and is what lets a 1M+-row database scale past one device's HBM.
+
+The reference aborts when sizes don't divide the rank count
+(knn_mpi.cpp:127-129); here :func:`pad_to_multiple` pads instead and callers
+mask/slice the padding away.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+QUERY_AXIS = "query"
+DB_AXIS = "db"
+
+
+def make_mesh(
+    query_shards: Optional[int] = None,
+    db_shards: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 2-D ``Mesh`` with axes ``(QUERY_AXIS, DB_AXIS)``.
+
+    ``query_shards=None`` takes every remaining device after ``db_shards``.
+    A single-device mesh (1, 1) is valid and runs the same SPMD program the
+    pod runs — there is no separate single-device code path.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if query_shards is None:
+        if n % db_shards:
+            raise ValueError(f"{n} devices not divisible by db_shards={db_shards}")
+        query_shards = n // db_shards
+    need = query_shards * db_shards
+    if need > n:
+        raise ValueError(f"mesh {query_shards}x{db_shards} needs {need} devices, have {n}")
+    grid = np.asarray(devices[:need]).reshape(query_shards, db_shards)
+    return Mesh(grid, (QUERY_AXIS, DB_AXIS))
+
+
+def default_mesh(db_shards: int = 1) -> Mesh:
+    """Mesh over all local devices; queries get every device not used by db."""
+    return make_mesh(None, db_shards)
+
+
+def pad_to_multiple(x: jax.Array, multiple: int, axis: int = 0) -> Tuple[jax.Array, int]:
+    """Zero-pad ``x`` along ``axis`` up to the next multiple.
+
+    Returns (padded, original_size).  Replaces the reference's divisibility
+    `MPI_Abort` (knn_mpi.cpp:127-129): any size works on any mesh.
+    """
+    n = x.shape[axis]
+    padded = -(-n // multiple) * multiple
+    if padded == n:
+        return x, n
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, padded - n)
+    import jax.numpy as jnp
+
+    return jnp.pad(x, widths), n

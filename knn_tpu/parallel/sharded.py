@@ -1,0 +1,305 @@
+"""Distributed KNN over a 2-D device mesh — the SPMD program that replaces
+the reference's rank-parallel main loop (knn_mpi.cpp:224-227,308-393).
+
+Two sharded axes (see parallel.mesh):
+
+- **query axis** — the reference's strategy: queries scattered, train
+  replicated, zero inter-device traffic during the distance phase, results
+  stay sharded (the gather at knn_mpi.cpp:340,383 is just an output spec).
+- **db axis** — beyond the reference: train rows sharded too.  Each device
+  computes a *local* top-k against its train shard with globalized indices,
+  then the shards merge.  Two merge strategies, bitwise-identical results:
+
+    * ``allgather``: one `lax.all_gather` of the [Qs, k] candidate lists
+      over the db axis, one lexicographic re-select.  One collective, P*k
+      candidate volume — the right choice when k*P is small.
+    * ``ring``: P-1 `lax.ppermute` steps passing a constant [Qs, k] buffer
+      around the db ring, merging locally each step — the KNN analogue of
+      ring attention (SURVEY.md §5 long-context row).  Constant memory,
+      overlappable with compute; the right shape when P or k is large.
+
+  The merge is the lexicographic (distance, index) top-k (ops.topk), which
+  is associative + commutative, so both strategies and any device count
+  agree bitwise with the single-device result.
+
+The reference's distributed min-max normalize (knn_mpi.cpp:229-306) maps to
+:func:`sharded_minmax`: local extrema + `lax.pmin`/`lax.pmax` over the mesh
+— its two `MPI_Allreduce` calls (knn_mpi.cpp:276-277) verbatim.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from knn_tpu.ops.normalize import local_minmax, minmax_apply
+from knn_tpu.ops.topk import knn_search_tiled, merge_topk, topk_pairs
+from knn_tpu.ops.vote import majority_vote
+from knn_tpu.parallel.mesh import DB_AXIS, QUERY_AXIS, pad_to_multiple
+
+_INT_SENTINEL = jnp.iinfo(jnp.int32).max
+
+
+def _ring_merge(d, i, k: int, axis_name: str, n_shards: int):
+    """P-1 ppermute steps around the ring; each device ends with the global
+    top-k.  Order-independent thanks to the lexicographic merge."""
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def body(_, carry):
+        acc_d, acc_i, buf_d, buf_i = carry
+        buf_d = lax.ppermute(buf_d, axis_name, perm)
+        buf_i = lax.ppermute(buf_i, axis_name, perm)
+        acc_d, acc_i = merge_topk(acc_d, acc_i, buf_d, buf_i, k)
+        return acc_d, acc_i, buf_d, buf_i
+
+    acc_d, acc_i, _, _ = lax.fori_loop(1, n_shards, body, (d, i, d, i))
+    return acc_d, acc_i
+
+
+def _allgather_merge(d, i, k: int, axis_name: str):
+    ad = lax.all_gather(d, axis_name, axis=0)  # [P, Qs, k]
+    ai = lax.all_gather(i, axis_name, axis=0)
+    qs = d.shape[0]
+    ad = jnp.moveaxis(ad, 0, 1).reshape(qs, -1)
+    ai = jnp.moveaxis(ai, 0, 1).reshape(qs, -1)
+    return topk_pairs(ad, ai, k)
+
+
+_MERGES = ("allgather", "ring")
+
+
+def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype):
+    """Local shard top-k with global train indices.
+
+    The last db shard may contain zero-padding rows; their distances are
+    forced to +inf *inside* the selection (``n_valid``) so a pad row can
+    never displace a real neighbor from the local top-k.
+    """
+    db_idx = lax.axis_index(DB_AXIS)
+    n_local_valid = jnp.clip(n_train - db_idx * t.shape[0], 0, t.shape[0])
+    d, i = knn_search_tiled(
+        q, t, k, metric, train_tile=train_tile, compute_dtype=compute_dtype,
+        n_valid=n_local_valid,
+    )
+    pad = i >= n_local_valid
+    gi = jnp.where(pad, _INT_SENTINEL, i + db_idx * t.shape[0])
+    return jnp.where(pad, jnp.inf, d), gi
+
+
+def _merged_topk(q, t, k, metric, merge, n_train, train_tile, compute_dtype, db_shards):
+    """Shared SPMD body: local shard top-k, then merge across the db axis."""
+    d, gi = _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype)
+    if db_shards > 1:
+        if merge == "ring":
+            d, gi = _ring_merge(d, gi, k, DB_AXIS, db_shards)
+        else:
+            d, gi = _allgather_merge(d, gi, k, DB_AXIS)
+    return d, gi
+
+
+@functools.lru_cache(maxsize=64)
+def _knn_program(
+    mesh: Mesh,
+    k: int,
+    metric: str,
+    merge: str,
+    n_train: int,
+    train_tile: Optional[int],
+    compute_dtype,
+):
+    db_shards = mesh.shape[DB_AXIS]
+
+    def spmd(q, t):
+        return _merged_topk(
+            q, t, k, metric, merge, n_train, train_tile, compute_dtype, db_shards
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS)),
+            out_specs=(P(QUERY_AXIS), P(QUERY_AXIS)),
+            check_vma=False,  # merged output is replicated along db by construction
+        )
+    )
+
+
+def sharded_knn(
+    queries: jax.Array,
+    train: jax.Array,
+    k: int,
+    *,
+    mesh: Mesh,
+    metric: str = "l2",
+    merge: str = "allgather",
+    train_tile: Optional[int] = None,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact KNN sharded over ``mesh``: (distances, global indices), [Q, k].
+
+    Queries are sharded along the query axis, train along the db axis; both
+    are padded to the mesh (the reference aborts instead,
+    knn_mpi.cpp:127-129).  Results are bitwise-equal to single-device
+    ``knn_search`` for any mesh shape and either merge strategy.
+    """
+    if merge not in _MERGES:
+        raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
+    n_q, n_train = queries.shape[0], train.shape[0]
+    db_shards = mesh.shape[DB_AXIS]
+    qp, _ = pad_to_multiple(queries, mesh.shape[QUERY_AXIS])
+    tp, _ = pad_to_multiple(train, db_shards)
+    shard_rows = tp.shape[0] // db_shards
+    if k > shard_rows:
+        raise ValueError(
+            f"k={k} exceeds db shard size {shard_rows}; use fewer db shards"
+        )
+    dtype_key = None if compute_dtype is None else jnp.dtype(compute_dtype).name
+    fn = _knn_program(mesh, k, metric, merge, n_train, train_tile, dtype_key)
+    qp = jax.device_put(qp, NamedSharding(mesh, P(QUERY_AXIS)))
+    tp = jax.device_put(tp, NamedSharding(mesh, P(DB_AXIS)))
+    d, i = fn(qp, tp)
+    return d[:n_q], i[:n_q]
+
+
+@functools.lru_cache(maxsize=64)
+def _predict_program(
+    mesh: Mesh,
+    k: int,
+    num_classes: int,
+    metric: str,
+    merge: str,
+    n_train: int,
+    train_tile: Optional[int],
+    compute_dtype,
+):
+    db_shards = mesh.shape[DB_AXIS]
+
+    def spmd(q, t, labels):
+        d, gi = _merged_topk(
+            q, t, k, metric, merge, n_train, train_tile, compute_dtype, db_shards
+        )
+        safe = jnp.minimum(gi, n_train - 1)  # sentinel survives only if n_train < k (raised)
+        return majority_vote(labels[safe], num_classes)
+
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P()),
+            out_specs=P(QUERY_AXIS),
+            check_vma=False,
+        )
+    )
+
+
+def sharded_knn_predict(
+    train: jax.Array,
+    train_labels: jax.Array,
+    queries: jax.Array,
+    *,
+    k: int,
+    num_classes: int,
+    mesh: Mesh,
+    metric: str = "l2",
+    merge: str = "allgather",
+    train_tile: Optional[int] = None,
+    compute_dtype=None,
+) -> jax.Array:
+    """Distributed classify: the whole reference KNN phase (distance fill →
+    select → vote, knn_mpi.cpp:308-393) as one SPMD program.  Labels ride
+    replicated (they are tiny next to features); votes happen on-device so
+    only final labels leave the mesh."""
+    if merge not in _MERGES:
+        raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
+    n_q = queries.shape[0]
+    qp, _ = pad_to_multiple(queries, mesh.shape[QUERY_AXIS])
+    tp, _ = pad_to_multiple(train, mesh.shape[DB_AXIS])
+    shard_rows = tp.shape[0] // mesh.shape[DB_AXIS]
+    if k > shard_rows:
+        raise ValueError(f"k={k} exceeds db shard size {shard_rows}")
+    dtype_key = None if compute_dtype is None else jnp.dtype(compute_dtype).name
+    fn = _predict_program(
+        mesh, k, num_classes, metric, merge, train.shape[0], train_tile, dtype_key
+    )
+    qp = jax.device_put(qp, NamedSharding(mesh, P(QUERY_AXIS)))
+    tp = jax.device_put(tp, NamedSharding(mesh, P(DB_AXIS)))
+    labels = jax.device_put(
+        jnp.asarray(train_labels, dtype=jnp.int32), NamedSharding(mesh, P())
+    )
+    return fn(qp, tp, labels)[:n_q]
+
+
+@functools.lru_cache(maxsize=16)
+def _minmax_program(mesh: Mesh, n_arrays: int):
+    def spmd(*arrays):
+        lo, hi = None, None
+        for a in arrays:
+            alo, ahi = local_minmax(a)
+            lo = alo if lo is None else jnp.minimum(lo, alo)
+            hi = ahi if hi is None else jnp.maximum(hi, ahi)
+        # The reference's two Allreduces, knn_mpi.cpp:276-277:
+        lo = lax.pmin(lax.pmin(lo, QUERY_AXIS), DB_AXIS)
+        hi = lax.pmax(lax.pmax(hi, QUERY_AXIS), DB_AXIS)
+        return lo, hi
+
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=tuple(P((QUERY_AXIS, DB_AXIS)) for _ in range(n_arrays)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def sharded_minmax(
+    arrays: Sequence[jax.Array], *, mesh: Mesh
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed per-dim (min, max) over the union of several [N_i, D]
+    arrays — the reference's transductive extrema phase (knn_mpi.cpp:245-277)
+    with pmin/pmax standing in for its Allreduce pair.  Row padding uses
+    edge replication, which leaves extrema unchanged.  Empty arrays are the
+    reduce identity (+inf, -inf), matching ops.normalize.local_minmax."""
+    arrays = list(arrays)
+    if not arrays:
+        raise ValueError("sharded_minmax needs at least one array")
+    dim = arrays[0].shape[-1]
+    nonempty = [a for a in arrays if a.shape[0] > 0]
+    if not nonempty:
+        return (
+            jnp.full((dim,), jnp.inf, dtype=jnp.float32),
+            jnp.full((dim,), -jnp.inf, dtype=jnp.float32),
+        )
+    n_dev = mesh.size
+    padded = []
+    for a in nonempty:
+        n = a.shape[0]
+        target = max(-(-n // n_dev) * n_dev, n_dev)
+        if target != n:
+            a = jnp.pad(a, ((0, target - n), (0, 0)), mode="edge")
+        padded.append(jax.device_put(a, NamedSharding(mesh, P((QUERY_AXIS, DB_AXIS)))))
+    fn = _minmax_program(mesh, len(padded))
+    return fn(*padded)
+
+
+def sharded_normalize_transductive(
+    train: jax.Array,
+    test: Optional[jax.Array] = None,
+    val: Optional[jax.Array] = None,
+    *,
+    mesh: Mesh,
+):
+    """Reference L2 phase (knn_mpi.cpp:229-306) on the mesh: joint extrema
+    over train ∪ test ∪ val, then in-place rescale with constant dims passed
+    through.  Returns (train', test', val') with None passed through."""
+    present = [a for a in (train, test, val) if a is not None]
+    lo, hi = sharded_minmax(present, mesh=mesh)
+    apply = jax.jit(minmax_apply)
+    return tuple(None if a is None else apply(a, lo, hi) for a in (train, test, val))

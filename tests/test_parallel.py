@@ -1,0 +1,146 @@
+"""Multi-device tests on the 8-virtual-CPU-device mesh (conftest.py) — these
+devices play the role MPI ranks play in the reference (SURVEY.md §4).
+
+Core claim under test: sharded execution is *bitwise identical* to
+single-device execution for every mesh shape and merge strategy, because
+the (distance, index) lexicographic merge is associative + commutative.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from knn_tpu.ops.normalize import minmax_stats, normalize_transductive
+from knn_tpu.ops.topk import knn_search
+from knn_tpu.models.classifier import knn_predict
+from knn_tpu.parallel import (
+    make_mesh,
+    sharded_knn,
+    sharded_knn_predict,
+    sharded_minmax,
+    sharded_normalize_transductive,
+)
+
+MESH_SHAPES = [(1, 1), (8, 1), (1, 8), (4, 2), (2, 4)]
+
+
+def _data(rng, n_train=160, n_q=48, dim=16, ties=True):
+    train = rng.normal(size=(n_train, dim)).astype(np.float32)
+    if ties:
+        # duplicate rows => exact distance ties across db shard boundaries
+        train[n_train // 2 :] = train[: n_train // 2]
+    queries = rng.normal(size=(n_q, dim)).astype(np.float32)
+    return jnp.asarray(train), jnp.asarray(queries)
+
+
+@pytest.mark.parametrize("mesh_shape", MESH_SHAPES)
+@pytest.mark.parametrize("merge", ["allgather", "ring"])
+def test_sharded_knn_matches_single_device(rng, mesh_shape, merge):
+    train, queries = _data(rng)
+    mesh = make_mesh(*mesh_shape)
+    ref_d, ref_i = knn_search(queries, train, k=7)
+    d, i = sharded_knn(queries, train, 7, mesh=mesh, merge=merge)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+    np.testing.assert_allclose(np.asarray(d), np.asarray(ref_d), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("merge", ["allgather", "ring"])
+def test_sharded_knn_ragged_sizes(rng, merge):
+    # sizes that divide neither mesh axis: the reference would MPI_Abort here
+    train, queries = _data(rng, n_train=149, n_q=37, ties=False)
+    mesh = make_mesh(4, 2)
+    ref_d, ref_i = knn_search(queries, train, k=5)
+    d, i = sharded_knn(queries, train, 5, mesh=mesh, merge=merge)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+    np.testing.assert_allclose(np.asarray(d), np.asarray(ref_d), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("metric", ["l2", "l1", "cosine"])
+def test_sharded_knn_metrics(rng, metric):
+    train, queries = _data(rng, ties=False)
+    mesh = make_mesh(2, 4)
+    ref_d, ref_i = knn_search(queries, train, k=5, metric=metric)
+    d, i = sharded_knn(queries, train, 5, mesh=mesh, metric=metric)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+def test_sharded_predict_matches_single_device(rng, mesh_shape):
+    train, queries = _data(rng)
+    labels = jnp.asarray(rng.integers(0, 5, size=train.shape[0]), dtype=jnp.int32)
+    mesh = make_mesh(*mesh_shape)
+    ref = knn_predict(train, labels, queries, k=9, num_classes=5)
+    got = sharded_knn_predict(
+        train, labels, queries, k=9, num_classes=5, mesh=mesh
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_sharded_knn_train_tile_composes(rng):
+    # db-axis sharding composed with within-shard HBM tiling
+    train, queries = _data(rng, n_train=200, ties=False)
+    mesh = make_mesh(2, 2)
+    ref_d, ref_i = knn_search(queries, train, k=5)
+    d, i = sharded_knn(queries, train, 5, mesh=mesh, train_tile=17)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+
+
+@pytest.mark.parametrize("merge", ["allgather", "ring"])
+def test_sharded_knn_pad_rows_cannot_displace_neighbors(rng, merge):
+    # Regression: n_train=10 on a db axis of 4 pads the last shard with zero
+    # rows; a query near the origin is closer to the zero pad than to most
+    # real rows, so pad rows must be masked *inside* the local selection.
+    train = jnp.asarray(rng.normal(size=(10, 8)).astype(np.float32))
+    queries = jnp.asarray(0.01 * rng.normal(size=(3, 8)).astype(np.float32))
+    mesh = make_mesh(2, 4)
+    ref_d, ref_i = knn_search(queries, train, k=2)
+    d, i = sharded_knn(queries, train, 2, mesh=mesh, merge=merge)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+    np.testing.assert_allclose(np.asarray(d), np.asarray(ref_d), rtol=1e-5, atol=1e-6)
+    labels = jnp.asarray(np.arange(10) % 3, dtype=jnp.int32)
+    ref_p = knn_predict(train, labels, queries, k=2, num_classes=3)
+    got_p = sharded_knn_predict(train, labels, queries, k=2, num_classes=3, mesh=mesh, merge=merge)
+    np.testing.assert_array_equal(np.asarray(got_p), np.asarray(ref_p))
+
+
+def test_sharded_knn_rejects_unknown_merge(rng):
+    train, queries = _data(rng, ties=False)
+    labels = jnp.zeros(train.shape[0], dtype=jnp.int32)
+    mesh = make_mesh(2, 4)
+    with pytest.raises(ValueError, match="unknown merge"):
+        sharded_knn(queries, train, 3, mesh=mesh, merge="rng")
+    with pytest.raises(ValueError, match="unknown merge"):
+        sharded_knn_predict(train, labels, queries, k=3, num_classes=1, mesh=mesh, merge="rng")
+
+
+def test_sharded_minmax_empty_array(rng):
+    train = jnp.asarray(rng.normal(size=(12, 5)).astype(np.float32))
+    empty = jnp.zeros((0, 5), dtype=jnp.float32)
+    mesh = make_mesh(4, 2)
+    ref_lo, ref_hi = minmax_stats([train, empty])
+    lo, hi = sharded_minmax([train, empty], mesh=mesh)
+    np.testing.assert_allclose(np.asarray(lo), np.asarray(ref_lo), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(hi), np.asarray(ref_hi), rtol=1e-6)
+
+
+def test_sharded_minmax_matches_local(rng):
+    arrs = [
+        jnp.asarray(rng.normal(size=(n, 6)).astype(np.float32) * s)
+        for n, s in [(33, 1.0), (17, 5.0), (9, 0.1)]
+    ]
+    mesh = make_mesh(4, 2)
+    ref_lo, ref_hi = minmax_stats(arrs)
+    lo, hi = sharded_minmax(arrs, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(lo), np.asarray(ref_lo), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(hi), np.asarray(ref_hi), rtol=1e-6)
+
+
+def test_sharded_normalize_matches_reference_semantics(rng):
+    train = jnp.asarray(rng.normal(size=(40, 5)).astype(np.float32))
+    test = jnp.asarray(rng.normal(size=(21, 5)).astype(np.float32) * 3)
+    val = jnp.asarray(rng.normal(size=(13, 5)).astype(np.float32))
+    mesh = make_mesh(8, 1)
+    ref = normalize_transductive(train, test, val)
+    got = sharded_normalize_transductive(train, test, val, mesh=mesh)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-5, atol=1e-6)
