@@ -1,0 +1,104 @@
+"""Command-line driver — the real flag system the reference never had
+(reconfiguration there = edit constants + recompile, knn_mpi.cpp:108-119,
+report PDF p.11 §3.3.1; SURVEY.md §5 calls the CLI the single biggest
+usability delta).
+
+Usage mirrors the reference job:
+
+    python -m knn_tpu.cli --train mnist_train.csv --test mnist_test.csv \\
+        --val mnist_validation.csv --k 50 --metric l2 --out Test_label.csv
+
+Prints the reference's two lines (``accuracy = ...`` knn_mpi.cpp:348 and
+``Running time is ... second`` :398) plus optional structured JSON metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from knn_tpu.ops.distance import METRICS
+from knn_tpu.utils.config import BACKENDS, JobConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu",
+        description="TPU-native distributed brute-force KNN classifier",
+    )
+    p.add_argument("--train", required=True, help="labeled train CSV (label,f0,f1,...)")
+    p.add_argument("--test", required=True, help="unlabeled test CSV (f0,f1,...)")
+    p.add_argument("--val", default=None, help="labeled validation CSV; enables accuracy scoring")
+    p.add_argument("--out", default="Test_label.csv", help="predicted-label output path")
+    p.add_argument("--k", type=int, default=50, help="neighbor count (ref K, knn_mpi.cpp:109)")
+    p.add_argument("--metric", default="l2", choices=sorted(METRICS))
+    p.add_argument("--dim", type=int, default=None, help="expected feature dim (validated)")
+    p.add_argument("--num-classes", type=int, default=None, help="label count (inferred if omitted)")
+    p.add_argument("--no-normalize", action="store_true", help="skip min-max normalization (ref Normalize=false)")
+    p.add_argument("--backend", default="jax", choices=BACKENDS)
+    p.add_argument("--query-shards", type=int, default=None, help="mesh query-axis size (default: all devices)")
+    p.add_argument("--db-shards", type=int, default=1, help="mesh db-axis size (shards the train rows)")
+    p.add_argument("--merge", default="allgather", choices=("allgather", "ring"))
+    p.add_argument("--train-tile", type=int, default=None, help="HBM tile rows for the streamed distance matrix")
+    p.add_argument("--batch-size", type=int, default=None, help="queries per device step")
+    p.add_argument("--compute-dtype", default=None, help="matmul dtype, e.g. bfloat16")
+    p.add_argument("--num-threads", type=int, default=0, help="native backend threads (0 = all cores)")
+    p.add_argument("--metrics-json", default=None, help="write structured run metrics to this path")
+    p.add_argument(
+        "--cpu-devices",
+        type=int,
+        default=None,
+        metavar="N",
+        help="force an N-virtual-device CPU backend (testing without a TPU; "
+        "must be set before any other JAX use in the process)",
+    )
+    return p
+
+
+def args_to_config(args: argparse.Namespace) -> JobConfig:
+    return JobConfig(
+        train_file=args.train,
+        test_file=args.test,
+        val_file=args.val,
+        output_file=args.out,
+        dim=args.dim,
+        k=args.k,
+        num_classes=args.num_classes,
+        metric=args.metric,
+        normalize=not args.no_normalize,
+        validation=args.val is not None,
+        backend=args.backend,
+        query_shards=args.query_shards,
+        db_shards=args.db_shards,
+        merge=args.merge,
+        train_tile=args.train_tile,
+        batch_size=args.batch_size,
+        compute_dtype=args.compute_dtype,
+        num_threads=args.num_threads,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cpu_devices:
+        import jax
+
+        # Must precede backend initialization; env vars are too late when a
+        # sitecustomize hook has already registered an accelerator plugin.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    from knn_tpu.pipeline import run_job  # deferred: JAX import is heavy
+
+    result = run_job(args_to_config(args))
+    if result.val_accuracy is not None:
+        print(f"accuracy = {result.val_accuracy}")  # knn_mpi.cpp:348
+    print(f"Running time is {result.total_time} second")  # knn_mpi.cpp:398
+    if args.metrics_json:
+        with open(args.metrics_json, "w") as f:
+            f.write(result.metrics_json())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""L1 data / I/O layer: the reference's per-rank CSV readers and label
+writer (knn_mpi.cpp:154-222, 385-393) as reusable host-side loaders, plus
+the fvecs/ivecs formats of the SIFT1M/GIST1M benchmark suite and synthetic
+dataset generators for tests and benchmarks.
+
+I/O stays on host by design (SURVEY.md §7): arrays cross to device once,
+as a whole, via the placement collectives in knn_tpu.parallel.
+"""
+
+from knn_tpu.data.csv_io import (
+    read_labeled_csv,
+    read_unlabeled_csv,
+    write_labels,
+)
+from knn_tpu.data.vecs import read_fvecs, read_ivecs, read_bvecs, write_fvecs, write_ivecs
+from knn_tpu.data.datasets import make_blobs, save_labeled_csv, save_unlabeled_csv
+
+__all__ = [
+    "read_labeled_csv",
+    "read_unlabeled_csv",
+    "write_labels",
+    "read_fvecs",
+    "read_ivecs",
+    "read_bvecs",
+    "write_fvecs",
+    "write_ivecs",
+    "make_blobs",
+    "save_labeled_csv",
+    "save_unlabeled_csv",
+]

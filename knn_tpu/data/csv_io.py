@@ -1,0 +1,96 @@
+"""CSV readers/writers matching the reference's formats exactly.
+
+Reference input contract (knn_mpi.cpp:154-222; report PDF p.11 §3.3.2):
+- labeled rows (train/val): ``label,f0,f1,...,f{dim-1}`` — integer label
+  first, then ``dim`` float features (the reader at :154-175 peels every
+  (dim+1)-th token off as a label);
+- unlabeled rows (test): ``f0,...,f{dim-1}`` (:177-197);
+- output: one predicted integer label per line, ``Test_label.csv``
+  (:385-393).
+
+Unlike the reference, row counts are discovered from the file rather than
+required up front (the reference needs N_train/N_test/N_val compiled in,
+knn_mpi.cpp:110-112), and malformed rows raise instead of silently
+corrupting the flat-array index arithmetic at knn_mpi.cpp:169-170.
+
+A native C++ fast path (knn_tpu.native) accelerates these readers when the
+shared library is built; this module is the always-available fallback.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _parse_rows(path: str, dtype) -> np.ndarray:
+    try:
+        from knn_tpu import native
+
+        if native.available():
+            return native.read_csv(path).astype(dtype, copy=False)
+    except ImportError:
+        pass
+    rows = []
+    width = None
+    with open(path, "r") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            vals = line.split(",")
+            if width is None:
+                width = len(vals)
+            elif len(vals) != width:
+                raise ValueError(
+                    f"{path}:{lineno}: expected {width} fields, got {len(vals)}"
+                )
+            rows.append(vals)
+    if not rows:
+        raise ValueError(f"{path}: empty CSV")
+    return np.asarray(rows, dtype=dtype)
+
+
+def read_labeled_csv(path: str, dim: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """(features [N, dim] float32, labels [N] int32) from label-first rows —
+    the train/val reader (knn_mpi.cpp:154-175, 198-222).
+
+    ``dim`` is validated if given (the reference trusts it blindly)."""
+    arr = _parse_rows(path, np.float32)
+    if arr.shape[1] < 2:
+        raise ValueError(f"{path}: labeled rows need a label and >=1 feature")
+    if dim is not None and arr.shape[1] != dim + 1:
+        raise ValueError(f"{path}: expected {dim}+1 columns, found {arr.shape[1]}")
+    labels = arr[:, 0]
+    if not np.all(labels == np.round(labels)):
+        raise ValueError(f"{path}: non-integer labels in first column")
+    return np.ascontiguousarray(arr[:, 1:]), labels.astype(np.int32)
+
+
+def read_unlabeled_csv(path: str, dim: Optional[int] = None) -> np.ndarray:
+    """Features [N, dim] float32 from unlabeled rows — the test reader
+    (knn_mpi.cpp:177-197)."""
+    arr = _parse_rows(path, np.float32)
+    if dim is not None and arr.shape[1] != dim:
+        raise ValueError(f"{path}: expected {dim} columns, found {arr.shape[1]}")
+    return arr
+
+
+def write_labels(path: str, labels) -> None:
+    """One integer label per line — the ``Test_label.csv`` writer
+    (knn_mpi.cpp:385-393)."""
+    labels = np.asarray(labels).astype(np.int64).ravel()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(str(int(x)) for x in labels))
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def read_labels(path: str) -> np.ndarray:
+    """Read a one-label-per-line file back (for parity tests against the
+    reference's output)."""
+    with open(path, "r") as f:
+        return np.asarray([int(line) for line in f if line.strip()], dtype=np.int32)

@@ -1,0 +1,68 @@
+"""Synthetic dataset generators for tests and benchmarks.
+
+The reference ships no data and no generators — its workload is MNIST CSVs
+prepared out of band (report PDF p.11 §3.3.2).  These generators produce
+(a) Gaussian-blob classification sets with a controllable difficulty, used
+as stand-ins for MNIST in tests/CLI fixtures, and (b) uniform/clustered
+float vectors at SIFT1M-like shapes for the benchmark harness.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from knn_tpu.data.csv_io import write_labels
+
+
+def make_blobs(
+    n_samples: int,
+    dim: int,
+    num_classes: int,
+    *,
+    cluster_std: float = 1.0,
+    center_spread: float = 5.0,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(features [N, dim] float32, labels [N] int32): isotropic Gaussian
+    clusters, one per class, classes cycling so every class is populated."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=center_spread, size=(num_classes, dim))
+    labels = (np.arange(n_samples) % num_classes).astype(np.int32)
+    rng.shuffle(labels)
+    feats = centers[labels] + rng.normal(scale=cluster_std, size=(n_samples, dim))
+    return feats.astype(np.float32), labels
+
+
+def make_database(
+    n: int, dim: int, *, seed: int = 0, scale: float = 128.0
+) -> np.ndarray:
+    """[n, dim] float32 uniform vectors in [0, scale) — a SIFT-like value
+    range for benchmark workloads."""
+    rng = np.random.default_rng(seed)
+    return (rng.random(size=(n, dim)) * scale).astype(np.float32)
+
+
+def save_labeled_csv(path: str, feats: np.ndarray, labels: np.ndarray) -> None:
+    """Write the reference's labeled format: ``label,f0,...`` per row
+    (the shape knn_mpi.cpp:154-175 parses)."""
+    with open(path, "w") as f:
+        for lab, row in zip(labels, feats):
+            f.write(str(int(lab)) + "," + ",".join(repr(float(v)) for v in row) + "\n")
+
+
+def save_unlabeled_csv(path: str, feats: np.ndarray) -> None:
+    """Write the reference's unlabeled test format (knn_mpi.cpp:177-197)."""
+    with open(path, "w") as f:
+        for row in feats:
+            f.write(",".join(repr(float(v)) for v in row) + "\n")
+
+
+__all__ = [
+    "make_blobs",
+    "make_database",
+    "save_labeled_csv",
+    "save_unlabeled_csv",
+    "write_labels",
+]

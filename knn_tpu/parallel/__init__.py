@@ -28,6 +28,7 @@ from knn_tpu.parallel.collectives import (
     barrier,
 )
 from knn_tpu.parallel.sharded import (
+    ShardedKNN,
     sharded_knn,
     sharded_knn_predict,
     sharded_minmax,
@@ -45,6 +46,7 @@ __all__ = [
     "allreduce_min",
     "allreduce_max",
     "barrier",
+    "ShardedKNN",
     "sharded_knn",
     "sharded_knn_predict",
     "sharded_minmax",

@@ -44,6 +44,9 @@ from knn_tpu.parallel.mesh import DB_AXIS, QUERY_AXIS, pad_to_multiple
 
 _INT_SENTINEL = jnp.iinfo(jnp.int32).max
 
+#: Module-level jitted rescale so repeated jobs hit the jit cache.
+_minmax_apply_jit = jax.jit(minmax_apply)
+
 
 def _ring_merge(d, i, k: int, axis_name: str, n_shards: int):
     """P-1 ppermute steps around the ring; each device ends with the global
@@ -130,6 +133,87 @@ def _knn_program(
     )
 
 
+class ShardedKNN:
+    """A placed distributed-KNN program: the database is padded, sharded
+    along the db axis, and transferred **once** at construction; every
+    subsequent :meth:`search`/:meth:`predict` call reuses the placement and
+    the compiled SPMD program.  This is the handle long-running services and
+    the batched pipeline use — the one-shot :func:`sharded_knn` /
+    :func:`sharded_knn_predict` wrappers construct a throwaway instance.
+
+    The reference has no equivalent: its train set is re-broadcast every
+    process launch (knn_mpi.cpp:224-225).
+    """
+
+    def __init__(
+        self,
+        train: jax.Array,
+        *,
+        mesh: Mesh,
+        k: int,
+        metric: str = "l2",
+        merge: str = "allgather",
+        train_tile: Optional[int] = None,
+        compute_dtype=None,
+        labels=None,
+        num_classes: Optional[int] = None,
+    ):
+        if merge not in _MERGES:
+            raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
+        db_shards = mesh.shape[DB_AXIS]
+        tp, n_train = pad_to_multiple(jnp.asarray(train), db_shards)
+        shard_rows = tp.shape[0] // db_shards
+        if k > shard_rows:
+            raise ValueError(
+                f"k={k} exceeds db shard size {shard_rows}; use fewer db shards"
+            )
+        if k > n_train:
+            raise ValueError(f"k={k} > n_train={n_train}")
+        self.mesh = mesh
+        self.k = k
+        self.metric = metric
+        self.merge = merge
+        self.train_tile = train_tile
+        self.n_train = n_train
+        self._dtype_key = (
+            None if compute_dtype is None else jnp.dtype(compute_dtype).name
+        )
+        self._tp = jax.device_put(tp, NamedSharding(mesh, P(DB_AXIS)))
+        self._labels = None
+        self.num_classes = num_classes
+        if labels is not None:
+            if num_classes is None:
+                raise ValueError("labels given without num_classes")
+            self._labels = jax.device_put(
+                jnp.asarray(labels, dtype=jnp.int32), NamedSharding(mesh, P())
+            )
+
+    def _place_queries(self, queries: jax.Array):
+        qp, n_q = pad_to_multiple(jnp.asarray(queries), self.mesh.shape[QUERY_AXIS])
+        return jax.device_put(qp, NamedSharding(self.mesh, P(QUERY_AXIS))), n_q
+
+    def search(self, queries: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """(distances, global indices) [Q, k] of the k nearest database rows."""
+        qp, n_q = self._place_queries(queries)
+        fn = _knn_program(
+            self.mesh, self.k, self.metric, self.merge, self.n_train,
+            self.train_tile, self._dtype_key,
+        )
+        d, i = fn(qp, self._tp)
+        return d[:n_q], i[:n_q]
+
+    def predict(self, queries: jax.Array) -> jax.Array:
+        """Predicted labels [Q] — requires ``labels`` at construction."""
+        if self._labels is None:
+            raise RuntimeError("ShardedKNN built without labels; predict unavailable")
+        qp, n_q = self._place_queries(queries)
+        fn = _predict_program(
+            self.mesh, self.k, self.num_classes, self.metric, self.merge,
+            self.n_train, self.train_tile, self._dtype_key,
+        )
+        return fn(qp, self._tp, self._labels)[:n_q]
+
+
 def sharded_knn(
     queries: jax.Array,
     train: jax.Array,
@@ -146,25 +230,14 @@ def sharded_knn(
     Queries are sharded along the query axis, train along the db axis; both
     are padded to the mesh (the reference aborts instead,
     knn_mpi.cpp:127-129).  Results are bitwise-equal to single-device
-    ``knn_search`` for any mesh shape and either merge strategy.
+    ``knn_search`` for any mesh shape and either merge strategy.  One-shot
+    wrapper over :class:`ShardedKNN`.
     """
-    if merge not in _MERGES:
-        raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
-    n_q, n_train = queries.shape[0], train.shape[0]
-    db_shards = mesh.shape[DB_AXIS]
-    qp, _ = pad_to_multiple(queries, mesh.shape[QUERY_AXIS])
-    tp, _ = pad_to_multiple(train, db_shards)
-    shard_rows = tp.shape[0] // db_shards
-    if k > shard_rows:
-        raise ValueError(
-            f"k={k} exceeds db shard size {shard_rows}; use fewer db shards"
-        )
-    dtype_key = None if compute_dtype is None else jnp.dtype(compute_dtype).name
-    fn = _knn_program(mesh, k, metric, merge, n_train, train_tile, dtype_key)
-    qp = jax.device_put(qp, NamedSharding(mesh, P(QUERY_AXIS)))
-    tp = jax.device_put(tp, NamedSharding(mesh, P(DB_AXIS)))
-    d, i = fn(qp, tp)
-    return d[:n_q], i[:n_q]
+    prog = ShardedKNN(
+        train, mesh=mesh, k=k, metric=metric, merge=merge,
+        train_tile=train_tile, compute_dtype=compute_dtype,
+    )
+    return prog.search(queries)
 
 
 @functools.lru_cache(maxsize=64)
@@ -214,25 +287,14 @@ def sharded_knn_predict(
     """Distributed classify: the whole reference KNN phase (distance fill →
     select → vote, knn_mpi.cpp:308-393) as one SPMD program.  Labels ride
     replicated (they are tiny next to features); votes happen on-device so
-    only final labels leave the mesh."""
-    if merge not in _MERGES:
-        raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
-    n_q = queries.shape[0]
-    qp, _ = pad_to_multiple(queries, mesh.shape[QUERY_AXIS])
-    tp, _ = pad_to_multiple(train, mesh.shape[DB_AXIS])
-    shard_rows = tp.shape[0] // mesh.shape[DB_AXIS]
-    if k > shard_rows:
-        raise ValueError(f"k={k} exceeds db shard size {shard_rows}")
-    dtype_key = None if compute_dtype is None else jnp.dtype(compute_dtype).name
-    fn = _predict_program(
-        mesh, k, num_classes, metric, merge, train.shape[0], train_tile, dtype_key
+    only final labels leave the mesh.  One-shot wrapper over
+    :class:`ShardedKNN`."""
+    prog = ShardedKNN(
+        train, mesh=mesh, k=k, metric=metric, merge=merge,
+        train_tile=train_tile, compute_dtype=compute_dtype,
+        labels=train_labels, num_classes=num_classes,
     )
-    qp = jax.device_put(qp, NamedSharding(mesh, P(QUERY_AXIS)))
-    tp = jax.device_put(tp, NamedSharding(mesh, P(DB_AXIS)))
-    labels = jax.device_put(
-        jnp.asarray(train_labels, dtype=jnp.int32), NamedSharding(mesh, P())
-    )
-    return fn(qp, tp, labels)[:n_q]
+    return prog.predict(queries)
 
 
 @functools.lru_cache(maxsize=16)
@@ -301,5 +363,6 @@ def sharded_normalize_transductive(
     through.  Returns (train', test', val') with None passed through."""
     present = [a for a in (train, test, val) if a is not None]
     lo, hi = sharded_minmax(present, mesh=mesh)
-    apply = jax.jit(minmax_apply)
-    return tuple(None if a is None else apply(a, lo, hi) for a in (train, test, val))
+    return tuple(
+        None if a is None else _minmax_apply_jit(a, lo, hi) for a in (train, test, val)
+    )

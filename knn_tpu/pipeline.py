@@ -1,0 +1,218 @@
+"""L4 driver: the reference's entire ``main()`` (knn_mpi.cpp:86-399) as a
+library function — read CSVs, distribute, transductively normalize, KNN both
+query sets, score validation, write ``Test_label.csv``, report time.
+
+Reference flow reproduced (SURVEY.md §1 data-flow):
+  ingest        <- rank-specialized CSV readers        knn_mpi.cpp:154-222
+  distribute    <- Bcast/Scatter placement             :224-227  (shardings)
+  normalize     <- joint extrema + Allreduce + rescale :229-306  (pmin/pmax)
+  knn val/test  <- distance/sort/vote per shard        :308-393  (SPMD program)
+  score         <- acc_calc on gathered val labels     :342-349
+  output        <- Test_label.csv writer               :385-393
+  timing        <- barrier-fenced Wtime pair           :133-134,395-398
+                   (upgraded to per-phase fences, utils.timing)
+
+Backends: ``jax`` (the TPU-native path, any mesh shape) and ``native`` (the
+C++ CPU parity oracle, knn_tpu.native).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from knn_tpu.data.csv_io import read_labeled_csv, read_unlabeled_csv, write_labels
+from knn_tpu.utils.config import JobConfig
+from knn_tpu.utils.timing import PhaseTimer
+
+
+@dataclass
+class JobResult:
+    """Everything the reference prints or writes, plus structured metrics."""
+
+    test_labels: np.ndarray
+    val_labels: Optional[np.ndarray]
+    val_accuracy: Optional[float]
+    phase_times: Dict[str, float]
+    total_time: float
+    n_train: int
+    n_test: int
+    n_val: int
+    config: JobConfig
+
+    @property
+    def queries_per_sec(self) -> float:
+        n = self.n_test + self.n_val
+        return n / self.total_time if self.total_time > 0 else float("inf")
+
+    def metrics(self) -> dict:
+        """Structured per-run JSON — the metrics/observability subsystem the
+        reference lacks (SURVEY.md §5: cout only, knn_mpi.cpp:348,398)."""
+        return {
+            "val_accuracy": self.val_accuracy,
+            "queries_per_sec": self.queries_per_sec,
+            "total_time_s": self.total_time,
+            "phase_times_s": self.phase_times,
+            "n_train": self.n_train,
+            "n_test": self.n_test,
+            "n_val": self.n_val,
+            "config": dataclasses.asdict(self.config),
+        }
+
+    def metrics_json(self) -> str:
+        return json.dumps(self.metrics(), indent=2)
+
+
+def _infer_num_classes(cfg: JobConfig, *label_arrays) -> int:
+    if cfg.num_classes is not None:
+        return cfg.num_classes
+    hi = 0
+    for a in label_arrays:
+        if a is not None and a.size:
+            hi = max(hi, int(a.max()))
+    return hi + 1
+
+
+def _accuracy(pred: np.ndarray, real: np.ndarray) -> float:
+    """``acc_calc`` (knn_mpi.cpp:69-84)."""
+    return float(np.mean(pred == real))
+
+
+def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
+             val_labels_real, mesh):
+    import jax.numpy as jnp
+
+    from knn_tpu.parallel.mesh import make_mesh
+    from knn_tpu.parallel.sharded import ShardedKNN, sharded_normalize_transductive
+
+    if mesh is None:
+        mesh = make_mesh(cfg.query_shards, cfg.db_shards)
+
+    with timer.phase("distribute"):
+        train_j = jnp.asarray(train)
+        test_j = jnp.asarray(test)
+        val_j = None if val is None else jnp.asarray(val)
+
+    if cfg.normalize:
+        with timer.phase("normalize"):
+            train_j, test_j, val_j = sharded_normalize_transductive(
+                train_j, test_j, val_j, mesh=mesh
+            )
+            timer.block(train_j, test_j, val_j)
+
+    num_classes = _infer_num_classes(cfg, train_labels, val_labels_real)
+
+    with timer.phase("distribute"):
+        # Database placed + sharded once; every batch reuses it.
+        program = ShardedKNN(
+            train_j,
+            mesh=mesh,
+            k=cfg.k,
+            metric=cfg.metric,
+            merge=cfg.merge,
+            train_tile=cfg.train_tile,
+            compute_dtype=cfg.compute_dtype,
+            labels=train_labels,
+            num_classes=num_classes,
+        )
+
+    def classify(queries):
+        n = queries.shape[0]
+        bs = cfg.batch_size or n
+        out = []
+        for start in range(0, n, bs):
+            chunk = queries[start : start + bs]
+            if chunk.shape[0] < bs:  # pad the tail so XLA sees one shape
+                chunk = jnp.pad(chunk, ((0, bs - chunk.shape[0]), (0, 0)))
+            out.append(np.asarray(program.predict(chunk))[: min(bs, n - start)])
+        return np.concatenate(out)
+
+    val_pred = None
+    if val_j is not None:
+        with timer.phase("knn_val"):
+            val_pred = classify(val_j)
+    with timer.phase("knn_test"):
+        test_pred = classify(test_j)
+    return test_pred, val_pred
+
+
+def _run_native(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
+                val_labels_real):
+    try:
+        from knn_tpu import native
+    except ImportError:
+        native = None
+    if native is None or not native.available():
+        raise RuntimeError(
+            "native backend requested but the C++ library is not built; "
+            "run `make -C knn_tpu/native` (see knn_tpu/native/README.md)"
+        )
+    num_classes = _infer_num_classes(cfg, train_labels, val_labels_real)
+    arrays = [a for a in (train, test, val) if a is not None]
+    if cfg.normalize:
+        with timer.phase("normalize"):
+            lo, hi = native.minmax_stats(arrays)
+            train = native.minmax_apply(train, lo, hi)
+            test = native.minmax_apply(test, lo, hi)
+            if val is not None:
+                val = native.minmax_apply(val, lo, hi)
+    val_pred = None
+    if val is not None:
+        with timer.phase("knn_val"):
+            val_pred = native.knn_predict(
+                train, train_labels, val, k=cfg.k, num_classes=num_classes,
+                metric=cfg.metric, num_threads=cfg.num_threads,
+            )
+    with timer.phase("knn_test"):
+        test_pred = native.knn_predict(
+            train, train_labels, test, k=cfg.k, num_classes=num_classes,
+            metric=cfg.metric, num_threads=cfg.num_threads,
+        )
+    return test_pred, val_pred
+
+
+def run_job(cfg: JobConfig, *, mesh=None) -> JobResult:
+    """Run the full reference job under ``cfg``; returns what the reference
+    prints/writes plus per-phase timings and throughput."""
+    timer = PhaseTimer()
+
+    with timer.phase("ingest"):
+        train, train_labels = read_labeled_csv(cfg.train_file, cfg.dim)
+        test = read_unlabeled_csv(cfg.test_file, cfg.dim or train.shape[1])
+        val, val_labels_real = (None, None)
+        if cfg.validation:
+            val, val_labels_real = read_labeled_csv(cfg.val_file, cfg.dim)
+    if cfg.k > train.shape[0]:
+        raise ValueError(f"k={cfg.k} > n_train={train.shape[0]}")
+
+    if cfg.backend == "native":
+        test_pred, val_pred = _run_native(
+            cfg, timer, train, train_labels, test, val, val_labels_real
+        )
+    else:
+        test_pred, val_pred = _run_jax(
+            cfg, timer, train, train_labels, test, val, val_labels_real, mesh
+        )
+
+    val_acc = None
+    if val_pred is not None:
+        val_acc = _accuracy(val_pred, val_labels_real)
+
+    with timer.phase("output"):
+        write_labels(cfg.output_file, test_pred)
+
+    return JobResult(
+        test_labels=np.asarray(test_pred),
+        val_labels=None if val_pred is None else np.asarray(val_pred),
+        val_accuracy=val_acc,
+        phase_times=timer.phases,
+        total_time=timer.total,
+        n_train=train.shape[0],
+        n_test=test.shape[0],
+        n_val=0 if val is None else val.shape[0],
+        config=cfg,
+    )

@@ -1,0 +1,6 @@
+"""L5 config + cross-cutting utilities (timing, metrics)."""
+
+from knn_tpu.utils.config import JobConfig
+from knn_tpu.utils.timing import PhaseTimer
+
+__all__ = ["JobConfig", "PhaseTimer"]

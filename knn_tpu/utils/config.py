@@ -1,0 +1,79 @@
+"""Runtime job configuration — the reference's compile-time constant block
+(knn_mpi.cpp:108-119; report PDF p.11 §3.2.2) promoted to a real config.
+
+The reference's documented workflow for changing any of these is *edit the
+source and recompile* (PDF p.11 §3.3.1); here they are dataclass fields fed
+by the CLI (knn_tpu.cli) — SURVEY.md §5 calls this the single biggest
+usability delta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from knn_tpu.ops.distance import METRICS
+
+#: Execution backends: JAX/XLA (TPU-native path) and the C++ CPU parity
+#: oracle (knn_tpu.native, SURVEY.md §7 step 3).
+BACKENDS = ("jax", "native")
+
+
+@dataclass
+class JobConfig:
+    """One KNN classification job.
+
+    Field ↔ reference mapping:
+      dim          <- ``dim``                 knn_mpi.cpp:108 (None = infer from file)
+      k            <- ``K``                   :109
+      num_classes  <- ``class_cnt``           :113 (None = infer from labels)
+      metric       <- ``Euclidean_distance``  :114 ('l2' true / 'l1' false, plus cosine/dot)
+      normalize    <- ``Normalize``           :115
+      validation   <- ``Validation``          :116
+      train_file / val_file / test_file      :117-119
+      output_file  <- the hard-coded ``Test_label.csv``  :390
+
+    Fields with no reference counterpart configure the TPU execution:
+    mesh shape (query_shards × db_shards), merge strategy, HBM train tile,
+    query batch size, and matmul dtype.
+    """
+
+    train_file: str = "mnist_train.csv"
+    test_file: str = "mnist_test.csv"
+    val_file: Optional[str] = "mnist_validation.csv"
+    output_file: str = "Test_label.csv"
+    dim: Optional[int] = None
+    k: int = 50
+    num_classes: Optional[int] = None
+    metric: str = "l2"
+    normalize: bool = True
+    validation: bool = True
+    backend: str = "jax"
+    # --- TPU execution knobs (no reference counterpart) ---
+    query_shards: Optional[int] = None
+    db_shards: int = 1
+    merge: str = "allgather"
+    train_tile: Optional[int] = None
+    batch_size: Optional[int] = None
+    compute_dtype: Optional[str] = None
+    # --- native backend knobs ---
+    num_threads: int = 0  # 0 = hardware concurrency
+
+    def __post_init__(self):
+        if self.metric.lower() not in METRICS:
+            raise ValueError(f"metric {self.metric!r} not in {METRICS}")
+        if self.backend not in BACKENDS:
+            raise ValueError(f"backend {self.backend!r} not in {BACKENDS}")
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.validation and not self.val_file:
+            raise ValueError("validation=True requires val_file")
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "JobConfig":
+        return cls(**json.loads(s))

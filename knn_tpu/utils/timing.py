@@ -1,0 +1,71 @@
+"""Per-phase timing — the observability the reference lacks.
+
+The reference has exactly one timer: a barrier-fenced ``MPI_Wtime`` pair
+around the entire job, printed by rank 0 (knn_mpi.cpp:133-134, 395-398), so
+its published numbers cannot attribute time to ingest vs communication vs
+compute (SURVEY.md §5).  ``PhaseTimer`` gives each phase its own fence:
+device work passed to :meth:`phase` is blocked on before the clock stops
+(JAX dispatch is async — without the block the timer measures dispatch
+latency, not compute).
+
+For deep dives, :func:`trace` wraps ``jax.profiler.trace`` to drop a
+TensorBoard-loadable XLA trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+class PhaseTimer:
+    """Accumulates named phase durations; total covers first start→last stop
+    (the reference's single Wtime pair, knn_mpi.cpp:134,396, recovered as
+    the sum)."""
+
+    def __init__(self):
+        self.phases: Dict[str, float] = {}
+        self._t0: Optional[float] = None
+        self._t_end: Optional[float] = None
+
+    @contextlib.contextmanager
+    def phase(self, name: str, *block_on):
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            for a in jax.tree_util.tree_leaves(block_on):
+                if isinstance(a, jax.Array):
+                    a.block_until_ready()
+            end = time.perf_counter()
+            self.phases[name] = self.phases.get(name, 0.0) + (end - start)
+            self._t_end = end
+
+    def block(self, *arrays) -> None:
+        """Fence device work into the *current* phase timing."""
+        for a in jax.tree_util.tree_leaves(arrays):
+            if isinstance(a, jax.Array):
+                a.block_until_ready()
+
+    @property
+    def total(self) -> float:
+        if self._t0 is None or self._t_end is None:
+            return 0.0
+        return self._t_end - self._t0
+
+    def summary(self) -> Dict[str, float]:
+        out = dict(self.phases)
+        out["total"] = self.total
+        return out
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """XLA profiler trace (TensorBoard format) around a code block."""
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,120 @@
+"""L1 data layer tests: reference CSV format round-trips, vecs formats,
+and malformed-input rejection (the reference silently corrupts instead,
+knn_mpi.cpp:169-170)."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.data import (
+    make_blobs,
+    read_bvecs,
+    read_fvecs,
+    read_ivecs,
+    read_labeled_csv,
+    read_unlabeled_csv,
+    save_labeled_csv,
+    save_unlabeled_csv,
+    write_fvecs,
+    write_ivecs,
+    write_labels,
+)
+from knn_tpu.data.csv_io import read_labels
+
+
+def test_labeled_csv_roundtrip(tmp_path, rng):
+    feats = rng.normal(size=(20, 7)).astype(np.float32)
+    labels = rng.integers(0, 4, size=20).astype(np.int32)
+    p = str(tmp_path / "train.csv")
+    save_labeled_csv(p, feats, labels)
+    f2, l2 = read_labeled_csv(p, dim=7)
+    np.testing.assert_array_equal(l2, labels)
+    np.testing.assert_allclose(f2, feats, rtol=1e-6)
+
+
+def test_unlabeled_csv_roundtrip(tmp_path, rng):
+    feats = rng.normal(size=(11, 3)).astype(np.float32)
+    p = str(tmp_path / "test.csv")
+    save_unlabeled_csv(p, feats)
+    np.testing.assert_allclose(read_unlabeled_csv(p, dim=3), feats, rtol=1e-6)
+
+
+def test_labels_roundtrip(tmp_path):
+    labels = np.asarray([3, 1, 4, 1, 5], dtype=np.int32)
+    p = str(tmp_path / "Test_label.csv")
+    write_labels(p, labels)
+    np.testing.assert_array_equal(read_labels(p), labels)
+    # format check: one integer per line, like knn_mpi.cpp:385-393 writes
+    assert open(p).read() == "3\n1\n4\n1\n5\n"
+
+
+def test_ragged_csv_rejected(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2.0,3.0\n2,4.0\n")
+    with pytest.raises(ValueError, match="expected 3 fields"):
+        read_labeled_csv(str(p))
+
+
+def test_wrong_dim_rejected(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("1,2.0,3.0\n")
+    with pytest.raises(ValueError, match="columns"):
+        read_labeled_csv(str(p), dim=5)
+
+
+def test_non_integer_labels_rejected(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("1.5,2.0,3.0\n")
+    with pytest.raises(ValueError, match="non-integer"):
+        read_labeled_csv(str(p))
+
+
+def test_empty_csv_rejected(tmp_path):
+    p = tmp_path / "e.csv"
+    p.write_text("\n\n")
+    with pytest.raises(ValueError, match="empty"):
+        read_unlabeled_csv(str(p))
+
+
+def test_fvecs_roundtrip(tmp_path, rng):
+    x = rng.normal(size=(9, 16)).astype(np.float32)
+    p = str(tmp_path / "a.fvecs")
+    write_fvecs(p, x)
+    np.testing.assert_array_equal(read_fvecs(p), x)
+
+
+def test_ivecs_roundtrip(tmp_path, rng):
+    x = rng.integers(0, 1000, size=(5, 100)).astype(np.int32)
+    p = str(tmp_path / "a.ivecs")
+    write_ivecs(p, x)
+    np.testing.assert_array_equal(read_ivecs(p), x)
+
+
+def test_bvecs_read(tmp_path, rng):
+    x = rng.integers(0, 256, size=(4, 8)).astype(np.uint8)
+    n, dim = x.shape
+    rows = np.concatenate(
+        [np.full((n, 1), dim, np.int32).view(np.uint8).reshape(n, 4), x], axis=1
+    )
+    p = str(tmp_path / "a.bvecs")
+    rows.tofile(p)
+    np.testing.assert_array_equal(read_bvecs(p), x)
+
+
+def test_truncated_vecs_rejected(tmp_path, rng):
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    p = str(tmp_path / "a.fvecs")
+    write_fvecs(p, x)
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-3])
+    with pytest.raises(ValueError, match="not a multiple"):
+        read_fvecs(p)
+
+
+def test_make_blobs_separable():
+    feats, labels = make_blobs(300, 8, 3, cluster_std=0.2, seed=1)
+    assert feats.shape == (300, 8) and labels.shape == (300,)
+    assert set(np.unique(labels)) == {0, 1, 2}
+    # tight, well-separated blobs: class centroids far apart vs spread
+    cents = np.stack([feats[labels == c].mean(0) for c in range(3)])
+    d01 = np.linalg.norm(cents[0] - cents[1])
+    assert d01 > 1.0

@@ -14,6 +14,7 @@ from knn_tpu.ops.normalize import minmax_stats, normalize_transductive
 from knn_tpu.ops.topk import knn_search
 from knn_tpu.models.classifier import knn_predict
 from knn_tpu.parallel import (
+    ShardedKNN,
     make_mesh,
     sharded_knn,
     sharded_knn_predict,
@@ -101,6 +102,29 @@ def test_sharded_knn_pad_rows_cannot_displace_neighbors(rng, merge):
     ref_p = knn_predict(train, labels, queries, k=2, num_classes=3)
     got_p = sharded_knn_predict(train, labels, queries, k=2, num_classes=3, mesh=mesh, merge=merge)
     np.testing.assert_array_equal(np.asarray(got_p), np.asarray(ref_p))
+
+
+def test_sharded_program_reuse(rng):
+    # the placed-once program answers repeated query batches correctly
+    train, queries = _data(rng, ties=False)
+    labels = jnp.asarray(rng.integers(0, 4, size=train.shape[0]), dtype=jnp.int32)
+    mesh = make_mesh(2, 4)
+    prog = ShardedKNN(train, mesh=mesh, k=5, labels=labels, num_classes=4)
+    for batch in (queries[:16], queries[16:32], queries[32:]):
+        ref_d, ref_i = knn_search(batch, train, k=5)
+        d, i = prog.search(batch)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+        ref_p = knn_predict(train, labels, batch, k=5, num_classes=4)
+        np.testing.assert_array_equal(np.asarray(prog.predict(batch)), np.asarray(ref_p))
+
+
+def test_sharded_program_without_labels_rejects_predict(rng):
+    train, queries = _data(rng, ties=False)
+    prog = ShardedKNN(train, mesh=make_mesh(8, 1), k=3)
+    with pytest.raises(RuntimeError, match="without labels"):
+        prog.predict(queries)
+    with pytest.raises(ValueError, match="num_classes"):
+        ShardedKNN(train, mesh=make_mesh(8, 1), k=3, labels=jnp.zeros(train.shape[0], jnp.int32))
 
 
 def test_sharded_knn_rejects_unknown_merge(rng):
