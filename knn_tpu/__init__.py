@@ -20,30 +20,53 @@ Layer map (mirrors SURVEY.md §1):
   L3 compute core   -> knn_tpu.ops.{distance,topk,vote}
   L4 eval / driver  -> knn_tpu.models, knn_tpu.pipeline, knn_tpu.cli
   L5 config         -> knn_tpu.utils.config
-"""
 
-from knn_tpu.ops.distance import pairwise_distance, pairwise_sq_l2, pairwise_l1, pairwise_cosine
-from knn_tpu.ops.topk import topk_smallest, merge_topk, knn_search, knn_search_tiled
-from knn_tpu.ops.vote import majority_vote
-from knn_tpu.ops.normalize import minmax_stats, minmax_apply, normalize_transductive
-from knn_tpu.models.classifier import KNNClassifier, knn_predict
+Attribute access is lazy (PEP 562) so light consumers — the CLI's flag
+parsing, config validation — don't pay the JAX import.
+"""
 
 __version__ = "0.1.0"
 
-__all__ = [
-    "pairwise_distance",
-    "pairwise_sq_l2",
-    "pairwise_l1",
-    "pairwise_cosine",
-    "topk_smallest",
-    "merge_topk",
-    "knn_search",
-    "knn_search_tiled",
-    "majority_vote",
-    "minmax_stats",
-    "minmax_apply",
-    "normalize_transductive",
-    "KNNClassifier",
-    "knn_predict",
-    "__version__",
-]
+# symbol -> defining submodule; resolved on first attribute access
+_EXPORTS = {
+    "pairwise_distance": "knn_tpu.ops.distance",
+    "pairwise_sq_l2": "knn_tpu.ops.distance",
+    "pairwise_l1": "knn_tpu.ops.distance",
+    "pairwise_cosine": "knn_tpu.ops.distance",
+    "METRICS": "knn_tpu.ops.metrics",
+    "topk_smallest": "knn_tpu.ops.topk",
+    "topk_pairs": "knn_tpu.ops.topk",
+    "merge_topk": "knn_tpu.ops.topk",
+    "knn_search": "knn_tpu.ops.topk",
+    "knn_search_tiled": "knn_tpu.ops.topk",
+    "knn_search_approx": "knn_tpu.ops.topk",
+    "majority_vote": "knn_tpu.ops.vote",
+    "minmax_stats": "knn_tpu.ops.normalize",
+    "minmax_apply": "knn_tpu.ops.normalize",
+    "normalize_transductive": "knn_tpu.ops.normalize",
+    "KNNClassifier": "knn_tpu.models.classifier",
+    "knn_predict": "knn_tpu.models.classifier",
+    "KNNRegressor": "knn_tpu.models.regressor",
+    "JobConfig": "knn_tpu.utils.config",
+    "run_job": "knn_tpu.pipeline",
+    "JobResult": "knn_tpu.pipeline",
+    "ShardedKNN": "knn_tpu.parallel.sharded",
+    "make_mesh": "knn_tpu.parallel.mesh",
+}
+
+__all__ = sorted(_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module 'knn_tpu' has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value  # cache for subsequent lookups
+    return value
+
+
+def __dir__():
+    return __all__

@@ -18,7 +18,7 @@ import argparse
 import sys
 from typing import Optional, Sequence
 
-from knn_tpu.ops.distance import METRICS
+from knn_tpu.ops.metrics import METRICS  # dependency-free; does not pull JAX
 from knn_tpu.utils.config import BACKENDS, JobConfig
 
 

@@ -22,8 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-#: Names accepted by :func:`pairwise_distance`.
-METRICS = ("l2", "sql2", "euclidean", "l1", "manhattan", "cosine", "dot")
+from knn_tpu.ops.metrics import METRICS  # re-exported: names for pairwise_distance
 
 
 def _dot(queries: jax.Array, train: jax.Array, compute_dtype) -> jax.Array:

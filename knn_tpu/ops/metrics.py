@@ -1,0 +1,10 @@
+"""Metric name registry — dependency-free so the CLI and config layers can
+validate flags without importing JAX (which costs seconds at startup).
+
+The actual distance implementations live in knn_tpu.ops.distance; the
+reference's metric "registry" is a single compile-time bool
+(``Euclidean_distance``, knn_mpi.cpp:114).
+"""
+
+#: Names accepted by knn_tpu.ops.distance.pairwise_distance.
+METRICS = ("l2", "sql2", "euclidean", "l1", "manhattan", "cosine", "dot")

@@ -60,11 +60,15 @@ def default_mesh(db_shards: int = 1) -> Mesh:
     return make_mesh(None, db_shards)
 
 
-def pad_to_multiple(x: jax.Array, multiple: int, axis: int = 0) -> Tuple[jax.Array, int]:
+def pad_to_multiple(x, multiple: int, axis: int = 0) -> Tuple[jax.Array, int]:
     """Zero-pad ``x`` along ``axis`` up to the next multiple.
 
     Returns (padded, original_size).  Replaces the reference's divisibility
     `MPI_Abort` (knn_mpi.cpp:127-129): any size works on any mesh.
+
+    NumPy inputs are padded **on host** so a later sharded ``device_put``
+    streams each shard straight to its device — the full array never
+    materializes on one device (the HBM-scaling contract of the db axis).
     """
     n = x.shape[axis]
     padded = -(-n // multiple) * multiple
@@ -72,6 +76,8 @@ def pad_to_multiple(x: jax.Array, multiple: int, axis: int = 0) -> Tuple[jax.Arr
         return x, n
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, padded - n)
+    if isinstance(x, np.ndarray):
+        return np.pad(x, widths), n
     import jax.numpy as jnp
 
     return jnp.pad(x, widths), n

@@ -34,6 +34,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -161,7 +162,9 @@ class ShardedKNN:
         if merge not in _MERGES:
             raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
         db_shards = mesh.shape[DB_AXIS]
-        tp, n_train = pad_to_multiple(jnp.asarray(train), db_shards)
+        if not isinstance(train, jax.Array):
+            train = np.asarray(train)  # keep on host; padding + placement stream shards
+        tp, n_train = pad_to_multiple(train, db_shards)
         shard_rows = tp.shape[0] // db_shards
         if k > shard_rows:
             raise ValueError(
@@ -184,12 +187,17 @@ class ShardedKNN:
         if labels is not None:
             if num_classes is None:
                 raise ValueError("labels given without num_classes")
-            self._labels = jax.device_put(
-                jnp.asarray(labels, dtype=jnp.int32), NamedSharding(mesh, P())
-            )
+            labels = np.asarray(labels, dtype=np.int32)
+            if labels.shape != (n_train,):
+                raise ValueError(
+                    f"labels shape {labels.shape} != (n_train,) = ({n_train},)"
+                )
+            self._labels = jax.device_put(labels, NamedSharding(mesh, P()))
 
-    def _place_queries(self, queries: jax.Array):
-        qp, n_q = pad_to_multiple(jnp.asarray(queries), self.mesh.shape[QUERY_AXIS])
+    def _place_queries(self, queries):
+        if not isinstance(queries, jax.Array):
+            queries = np.asarray(queries)
+        qp, n_q = pad_to_multiple(queries, self.mesh.shape[QUERY_AXIS])
         return jax.device_put(qp, NamedSharding(self.mesh, P(QUERY_AXIS))), n_q
 
     def search(self, queries: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -345,7 +353,8 @@ def sharded_minmax(
         n = a.shape[0]
         target = max(-(-n // n_dev) * n_dev, n_dev)
         if target != n:
-            a = jnp.pad(a, ((0, target - n), (0, 0)), mode="edge")
+            pad_fn = np.pad if isinstance(a, np.ndarray) else jnp.pad
+            a = pad_fn(a, ((0, target - n), (0, 0)), mode="edge")
         padded.append(jax.device_put(a, NamedSharding(mesh, P((QUERY_AXIS, DB_AXIS)))))
     fn = _minmax_program(mesh, len(padded))
     return fn(*padded)

@@ -82,34 +82,43 @@ def _accuracy(pred: np.ndarray, real: np.ndarray) -> float:
     return float(np.mean(pred == real))
 
 
+def _np_minmax_apply(x: np.ndarray, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Host-side rescale with the constant-dim passthrough guard
+    (knn_mpi.cpp:284) — applied on host so the full arrays never
+    materialize on a single device."""
+    rng = hi - lo
+    safe = np.where(rng != 0, rng, 1.0)
+    return np.where(rng != 0, (x - lo) / safe, x).astype(np.float32)
+
+
 def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
              val_labels_real, mesh):
-    import jax.numpy as jnp
-
     from knn_tpu.parallel.mesh import make_mesh
-    from knn_tpu.parallel.sharded import ShardedKNN, sharded_normalize_transductive
+    from knn_tpu.parallel.sharded import ShardedKNN, sharded_minmax
 
     if mesh is None:
         mesh = make_mesh(cfg.query_shards, cfg.db_shards)
 
-    with timer.phase("distribute"):
-        train_j = jnp.asarray(train)
-        test_j = jnp.asarray(test)
-        val_j = None if val is None else jnp.asarray(val)
-
     if cfg.normalize:
         with timer.phase("normalize"):
-            train_j, test_j, val_j = sharded_normalize_transductive(
-                train_j, test_j, val_j, mesh=mesh
-            )
-            timer.block(train_j, test_j, val_j)
+            # extrema via the distributed pmin/pmax reduction (the
+            # reference's Allreduce pair); the rescale applies on host so
+            # no full array ever lands on one device
+            present = [a for a in (train, test, val) if a is not None]
+            lo, hi = sharded_minmax(present, mesh=mesh)
+            lo, hi = np.asarray(lo), np.asarray(hi)
+            train = _np_minmax_apply(train, lo, hi)
+            test = _np_minmax_apply(test, lo, hi)
+            if val is not None:
+                val = _np_minmax_apply(val, lo, hi)
 
     num_classes = _infer_num_classes(cfg, train_labels, val_labels_real)
 
     with timer.phase("distribute"):
-        # Database placed + sharded once; every batch reuses it.
+        # Database padded on host, then placed shard-by-shard — once;
+        # every query batch reuses the placement and compiled program.
         program = ShardedKNN(
-            train_j,
+            train,
             mesh=mesh,
             k=cfg.k,
             metric=cfg.metric,
@@ -127,16 +136,16 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
         for start in range(0, n, bs):
             chunk = queries[start : start + bs]
             if chunk.shape[0] < bs:  # pad the tail so XLA sees one shape
-                chunk = jnp.pad(chunk, ((0, bs - chunk.shape[0]), (0, 0)))
+                chunk = np.pad(chunk, ((0, bs - chunk.shape[0]), (0, 0)))
             out.append(np.asarray(program.predict(chunk))[: min(bs, n - start)])
         return np.concatenate(out)
 
     val_pred = None
-    if val_j is not None:
+    if val is not None:
         with timer.phase("knn_val"):
-            val_pred = classify(val_j)
+            val_pred = classify(val)
     with timer.phase("knn_test"):
-        test_pred = classify(test_j)
+        test_pred = classify(test)
     return test_pred, val_pred
 
 
@@ -188,6 +197,17 @@ def run_job(cfg: JobConfig, *, mesh=None) -> JobResult:
             val, val_labels_real = read_labeled_csv(cfg.val_file, cfg.dim)
     if cfg.k > train.shape[0]:
         raise ValueError(f"k={cfg.k} > n_train={train.shape[0]}")
+    # Label range check, applied identically for both backends (the jax vote
+    # would silently drop out-of-range labels, the native one rejects them —
+    # the reference OOB-writes its vote array instead, knn_mpi.cpp:330).
+    if train_labels.size and train_labels.min() < 0:
+        raise ValueError(f"negative train label {int(train_labels.min())}")
+    if cfg.num_classes is not None and train_labels.size and (
+        train_labels.max() >= cfg.num_classes
+    ):
+        raise ValueError(
+            f"train label {int(train_labels.max())} outside [0, {cfg.num_classes})"
+        )
 
     if cfg.backend == "native":
         test_pred, val_pred = _run_native(

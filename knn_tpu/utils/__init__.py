@@ -1,6 +1,23 @@
-"""L5 config + cross-cutting utilities (timing, metrics)."""
+"""L5 config + cross-cutting utilities (timing, metrics).
 
-from knn_tpu.utils.config import JobConfig
-from knn_tpu.utils.timing import PhaseTimer
+Lazy exports: importing ``knn_tpu.utils.config`` must not pull JAX (the CLI
+parses flags through it), and ``timing`` imports JAX for device fences.
+"""
 
-__all__ = ["JobConfig", "PhaseTimer"]
+_EXPORTS = {
+    "JobConfig": "knn_tpu.utils.config",
+    "PhaseTimer": "knn_tpu.utils.timing",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module 'knn_tpu.utils' has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value
+    return value

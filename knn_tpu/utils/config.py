@@ -14,7 +14,7 @@ import json
 from dataclasses import dataclass
 from typing import Optional
 
-from knn_tpu.ops.distance import METRICS
+from knn_tpu.ops.metrics import METRICS
 
 #: Execution backends: JAX/XLA (TPU-native path) and the C++ CPU parity
 #: oracle (knn_tpu.native, SURVEY.md §7 step 3).

@@ -4,9 +4,9 @@ The reference has exactly one timer: a barrier-fenced ``MPI_Wtime`` pair
 around the entire job, printed by rank 0 (knn_mpi.cpp:133-134, 395-398), so
 its published numbers cannot attribute time to ingest vs communication vs
 compute (SURVEY.md §5).  ``PhaseTimer`` gives each phase its own fence:
-device work passed to :meth:`phase` is blocked on before the clock stops
-(JAX dispatch is async — without the block the timer measures dispatch
-latency, not compute).
+call :meth:`PhaseTimer.block` on the phase's device outputs before the
+phase block closes (JAX dispatch is async — without the fence the timer
+measures dispatch latency, not compute).
 
 For deep dives, :func:`trace` wraps ``jax.profiler.trace`` to drop a
 TensorBoard-loadable XLA trace.
@@ -32,16 +32,16 @@ class PhaseTimer:
         self._t_end: Optional[float] = None
 
     @contextlib.contextmanager
-    def phase(self, name: str, *block_on):
+    def phase(self, name: str):
+        """Time a named phase.  Call :meth:`block` inside the body on any
+        device arrays the phase produced — JAX dispatch is async, so the
+        fence must come from within, after the work exists."""
         if self._t0 is None:
             self._t0 = time.perf_counter()
         start = time.perf_counter()
         try:
             yield
         finally:
-            for a in jax.tree_util.tree_leaves(block_on):
-                if isinstance(a, jax.Array):
-                    a.block_until_ready()
             end = time.perf_counter()
             self.phases[name] = self.phases.get(name, 0.0) + (end - start)
             self._t_end = end

@@ -50,7 +50,8 @@ def test_labels_roundtrip(tmp_path):
 def test_ragged_csv_rejected(tmp_path):
     p = tmp_path / "bad.csv"
     p.write_text("1,2.0,3.0\n2,4.0\n")
-    with pytest.raises(ValueError, match="expected 3 fields"):
+    # message differs between the python fallback and the native fast path
+    with pytest.raises(ValueError, match="expected 3 fields|ragged"):
         read_labeled_csv(str(p))
 
 

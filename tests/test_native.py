@@ -1,0 +1,152 @@
+"""Parity tests: native C++ backend vs the JAX path on identical inputs —
+the two-backend cross-check SURVEY.md §4 prescribes (the reference itself
+has zero automated tests; its only oracle is the MNIST accuracy table)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from knn_tpu.data.datasets import make_blobs, save_labeled_csv, save_unlabeled_csv
+from knn_tpu.models.classifier import knn_predict as jax_knn_predict
+from knn_tpu.ops.normalize import minmax_apply as jax_minmax_apply
+from knn_tpu.ops.normalize import minmax_stats as jax_minmax_stats
+from knn_tpu.ops.topk import knn_search as jax_knn_search
+from knn_tpu.pipeline import run_job
+from knn_tpu.utils.config import JobConfig
+
+native = pytest.importorskip("knn_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built (no C++ toolchain?)"
+)
+
+
+@pytest.fixture
+def blob_data(rng):
+    feats, labels = make_blobs(200, 10, 4, cluster_std=1.0, seed=11)
+    # duplicate a block to force exact distance ties through both backends
+    feats[150:170] = feats[100:120]
+    queries = feats[180:].copy()
+    return feats[:180], labels[:180], queries
+
+
+def test_search_parity(blob_data):
+    train, _, queries = blob_data
+    nd, ni = native.knn_search(train, queries, 7)
+    jd, ji = jax_knn_search(jnp.asarray(queries), jnp.asarray(train), 7)
+    np.testing.assert_array_equal(ni, np.asarray(ji))
+    np.testing.assert_allclose(nd, np.asarray(jd), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("metric", ["l2", "l1", "cosine", "dot"])
+def test_search_parity_metrics(blob_data, metric):
+    train, _, queries = blob_data
+    nd, ni = native.knn_search(train, queries, 5, metric)
+    jd, ji = jax_knn_search(jnp.asarray(queries), jnp.asarray(train), 5, metric)
+    np.testing.assert_array_equal(ni, np.asarray(ji))
+
+
+def test_predict_parity(blob_data):
+    train, labels, queries = blob_data
+    np_pred = native.knn_predict(train, labels, queries, k=9, num_classes=4)
+    j_pred = jax_knn_predict(
+        jnp.asarray(train), jnp.asarray(labels), jnp.asarray(queries), k=9, num_classes=4
+    )
+    np.testing.assert_array_equal(np_pred, np.asarray(j_pred))
+
+
+def test_predict_vote_tie_semantics():
+    # 1-D layout engineering three-way ties: first-to-reach-max must win in
+    # (distance, index) neighbor order in both backends
+    train = np.asarray([[0.0], [1.0], [-1.0], [2.0], [-2.0], [3.0]], dtype=np.float32)
+    labels = np.asarray([2, 1, 1, 0, 0, 2], dtype=np.int32)
+    queries = np.asarray([[0.0], [0.4], [-0.4]], dtype=np.float32)
+    np_pred = native.knn_predict(train, labels, queries, k=5, num_classes=3)
+    j_pred = jax_knn_predict(
+        jnp.asarray(train), jnp.asarray(labels), jnp.asarray(queries), k=5, num_classes=3
+    )
+    np.testing.assert_array_equal(np_pred, np.asarray(j_pred))
+
+
+def test_predict_rejects_out_of_range_labels(blob_data):
+    train, labels, queries = blob_data
+    bad = labels.copy()
+    bad[0] = 99  # the reference would OOB-write its vote array (knn_mpi.cpp:330)
+    with pytest.raises(ValueError, match="label outside"):
+        native.knn_predict(train, bad, queries, k=9, num_classes=4)
+
+
+def test_minmax_parity(blob_data):
+    train, _, queries = blob_data
+    nlo, nhi = native.minmax_stats([train, queries])
+    jlo, jhi = jax_minmax_stats([jnp.asarray(train), jnp.asarray(queries)])
+    np.testing.assert_allclose(nlo, np.asarray(jlo), rtol=1e-6)
+    np.testing.assert_allclose(nhi, np.asarray(jhi), rtol=1e-6)
+    napp = native.minmax_apply(train, nlo, nhi)
+    japp = jax_minmax_apply(jnp.asarray(train), jlo, jhi)
+    np.testing.assert_allclose(napp, np.asarray(japp), rtol=1e-5, atol=1e-6)
+
+
+def test_minmax_constant_dim_passthrough():
+    x = np.asarray([[1.0, 5.0], [2.0, 5.0]], dtype=np.float32)
+    lo, hi = native.minmax_stats([x])
+    out = native.minmax_apply(x, lo, hi)
+    np.testing.assert_allclose(out[:, 0], [0.0, 1.0])
+    np.testing.assert_allclose(out[:, 1], [5.0, 5.0])  # knn_mpi.cpp:284 guard
+
+
+def test_native_csv_matches_python(tmp_path, rng):
+    feats = rng.normal(size=(30, 5)).astype(np.float32)
+    labels = rng.integers(0, 3, size=30).astype(np.int32)
+    p = str(tmp_path / "t.csv")
+    save_labeled_csv(p, feats, labels)
+    arr = native.read_csv(p)
+    assert arr.shape == (30, 6)
+    np.testing.assert_allclose(arr[:, 0], labels)
+    np.testing.assert_allclose(arr[:, 1:], feats, rtol=1e-6)
+
+
+def test_native_csv_rejects_trailing_comma(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("3,4,\n1,2,\n")
+    with pytest.raises(ValueError, match="parse error"):
+        native.read_csv(str(p))
+
+
+def test_native_accuracy():
+    a = np.asarray([1, 2, 3, 4], dtype=np.int32)
+    b = np.asarray([1, 0, 3, 0], dtype=np.int32)
+    assert native.accuracy(a, b) == 0.5
+
+
+def test_multithreaded_matches_single_thread(blob_data):
+    train, labels, queries = blob_data
+    one = native.knn_predict(train, labels, queries, k=7, num_classes=4, num_threads=1)
+    many = native.knn_predict(train, labels, queries, k=7, num_classes=4, num_threads=4)
+    np.testing.assert_array_equal(one, many)
+
+
+def test_pipeline_backend_parity(tmp_path):
+    feats, labels = make_blobs(240, 6, 3, cluster_std=0.8, seed=5)
+    paths = {
+        "train": str(tmp_path / "train.csv"),
+        "val": str(tmp_path / "val.csv"),
+        "test": str(tmp_path / "test.csv"),
+    }
+    save_labeled_csv(paths["train"], feats[:160], labels[:160])
+    save_labeled_csv(paths["val"], feats[160:200], labels[160:200])
+    save_unlabeled_csv(paths["test"], feats[200:])
+
+    def cfg(backend, out):
+        return JobConfig(
+            train_file=paths["train"], test_file=paths["test"], val_file=paths["val"],
+            output_file=str(tmp_path / out), k=5, backend=backend,
+            query_shards=4, db_shards=2 if backend == "jax" else 1,
+        )
+
+    jax_res = run_job(cfg("jax", "out_jax.csv"))
+    nat_res = run_job(cfg("native", "out_native.csv"))
+    np.testing.assert_array_equal(jax_res.test_labels, nat_res.test_labels)
+    np.testing.assert_array_equal(jax_res.val_labels, nat_res.val_labels)
+    assert jax_res.val_accuracy == nat_res.val_accuracy

@@ -118,6 +118,15 @@ def test_sharded_program_reuse(rng):
         np.testing.assert_array_equal(np.asarray(prog.predict(batch)), np.asarray(ref_p))
 
 
+def test_sharded_program_rejects_mismatched_labels(rng):
+    train, _ = _data(rng, ties=False)
+    with pytest.raises(ValueError, match="labels shape"):
+        ShardedKNN(
+            train, mesh=make_mesh(8, 1), k=3,
+            labels=jnp.zeros(train.shape[0] // 2, jnp.int32), num_classes=2,
+        )
+
+
 def test_sharded_program_without_labels_rejects_predict(rng):
     train, queries = _data(rng, ties=False)
     prog = ShardedKNN(train, mesh=make_mesh(8, 1), k=3)

@@ -98,6 +98,39 @@ def test_run_job_rejects_bad_k(job_files):
         run_job(_config(paths, k=9999))
 
 
+def test_run_job_rejects_out_of_range_labels(job_files, tmp_path):
+    paths, _ = job_files
+    # num_classes=2 but blobs have 3 classes: both backends must fail loudly
+    with pytest.raises(ValueError, match="outside"):
+        run_job(_config(paths, num_classes=2))
+
+
+def test_cli_parsing_does_not_import_jax():
+    # flag parsing must stay light: building the parser and validating a
+    # config cannot pull JAX into the process
+    import subprocess, sys
+
+    # NB: a sitecustomize hook may pre-import jax at interpreter start, so
+    # spy on *new* imports rather than inspecting sys.modules
+    code = (
+        "import sys\n"
+        "class Spy:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise AssertionError('jax imported during CLI parsing')\n"
+        "        return None\n"
+        "sys.meta_path.insert(0, Spy())\n"
+        "from knn_tpu.cli import build_parser\n"
+        "from knn_tpu.utils.config import JobConfig\n"
+        "build_parser().parse_args(['--train','t','--test','q'])\n"
+        "JobConfig()\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo"
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
 def test_metrics_json_structure(job_files):
     paths, _ = job_files
     result = run_job(_config(paths))
