@@ -1,0 +1,77 @@
+"""Exact candidate refinement: restore recall@k = 1.0 after a fast coarse
+pass.
+
+The TPU path ranks with float32 (or bfloat16) distances; at 1M-database
+scale a handful of near-boundary neighbors can swap order vs the float64
+oracle (the expanded-square cancellation SURVEY.md §7 hard part (c)).  The
+fix is the classic two-phase scheme: take k + margin candidates from the
+fast pass, re-score JUST those in float64 on host (O(Q·m·D), trivial next
+to the O(Q·N·D) coarse pass), and re-select the exact lexicographic top-k.
+
+Exactness condition: every true top-k member appears in the coarse
+top-(k+margin).  The coarse pass's worst-case distance error is a few
+float32 ulps of the squared-norm magnitude, so a margin of a few dozen
+covers it at SIFT1M scale; recall checks in bench.py verify empirically.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def _pairwise_f64(queries: np.ndarray, cand: np.ndarray, metric: str) -> np.ndarray:
+    """[Q, m] float64 distances between each query and its own candidate
+    rows (cand is [Q, m, D])."""
+    q = queries.astype(np.float64)[:, None, :]
+    c = cand.astype(np.float64)
+    m = metric.lower()
+    if m in ("l2", "sql2", "euclidean"):
+        diff = c - q
+        return np.einsum("qmd,qmd->qm", diff, diff)
+    if m in ("l1", "manhattan"):
+        return np.abs(c - q).sum(-1)
+    if m == "cosine":
+        qn = q / np.maximum(np.linalg.norm(q, axis=-1, keepdims=True), 1e-24)
+        cn = c / np.maximum(np.linalg.norm(c, axis=-1, keepdims=True), 1e-24)
+        return 1.0 - np.einsum("qmd,qmd->qm", cn, qn)
+    if m == "dot":
+        return -np.einsum("qmd,qmd->qm", c, q)
+    raise ValueError(f"unknown metric {metric!r}")
+
+
+def refine_exact(
+    db: np.ndarray,
+    queries: np.ndarray,
+    cand_idx: np.ndarray,
+    k: int,
+    metric: str = "l2",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(distances [Q, k] float64, indices [Q, k] int64): the exact
+    lexicographic (distance, index) top-k among each query's candidates.
+
+    ``cand_idx`` is [Q, m] with m >= k, from the coarse device pass.
+    Duplicate or sentinel (>= len(db)) candidate indices are tolerated:
+    duplicates keep one copy ranked by index, sentinels rank last.
+    """
+    cand_idx = np.asarray(cand_idx, dtype=np.int64)
+    n_q, m = cand_idx.shape
+    if m < k:
+        raise ValueError(f"need >= {k} candidates, got {m}")
+    valid = cand_idx < db.shape[0]
+    safe_idx = np.where(valid, cand_idx, 0)
+    d = _pairwise_f64(queries, db[safe_idx], metric)
+    d = np.where(valid, d, np.inf)
+    # kill duplicate candidates (keep lowest occurrence by (d, idx) order)
+    srt = np.lexsort((cand_idx, d), axis=-1)
+    d_sorted = np.take_along_axis(d, srt, axis=-1)
+    i_sorted = np.take_along_axis(cand_idx, srt, axis=-1)
+    dup = np.zeros_like(i_sorted, dtype=bool)
+    dup[:, 1:] = i_sorted[:, 1:] == i_sorted[:, :-1]
+    d_sorted = np.where(dup, np.inf, d_sorted)
+    srt2 = np.lexsort((i_sorted, d_sorted), axis=-1)[:, :k]
+    return (
+        np.take_along_axis(d_sorted, srt2, axis=-1),
+        np.take_along_axis(i_sorted, srt2, axis=-1),
+    )

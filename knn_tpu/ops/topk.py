@@ -131,10 +131,16 @@ def knn_search_tiled(
         tile_idx, tile = args
         d = pairwise_distance(queries, tile, metric, compute_dtype=compute_dtype)
         gidx = tile_idx * train_tile + lax.broadcasted_iota(jnp.int32, (1, train_tile), 1)
-        valid = gidx < limit
-        d = jnp.where(valid, d, jnp.inf)
-        gidx = jnp.broadcast_to(gidx, d.shape)
-        return merge_topk(best_d, best_i, d, gidx, k), None
+        d = jnp.where(gidx < limit, d, jnp.inf)
+        if train_tile > k:
+            # Reduce the tile to its local top-k *first* (exact: every
+            # global top-k member inside this tile is also in the tile's
+            # top-k), so the lexicographic merge sorts 2k candidates, not
+            # k + train_tile.
+            td, ti = topk_smallest(d, k)
+            tgi = tile_idx * train_tile + ti  # ti are tile-local columns
+            return merge_topk(best_d, best_i, td, tgi, k), None
+        return merge_topk(best_d, best_i, d, jnp.broadcast_to(gidx, d.shape), k), None
 
     (best_d, best_i), _ = lax.scan(
         step, (init_d, init_i), (jnp.arange(n_tiles, dtype=jnp.int32), tiles)

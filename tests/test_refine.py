@@ -1,0 +1,70 @@
+"""Exact refinement tests: coarse candidates + float64 re-select must equal
+the brute-force float64 oracle."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.ops.refine import refine_exact
+
+
+def _oracle(db, queries, k, metric="l2"):
+    q = queries.astype(np.float64)[:, None, :]
+    c = db.astype(np.float64)[None, :, :]
+    if metric == "l2":
+        d = ((c - q) ** 2).sum(-1)
+    elif metric == "l1":
+        d = np.abs(c - q).sum(-1)
+    else:
+        raise ValueError(metric)
+    idx = np.argsort(d, axis=-1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=-1), idx
+
+
+def test_refine_recovers_exact_topk(rng):
+    db = rng.normal(size=(500, 16)).astype(np.float32)
+    queries = rng.normal(size=(20, 16)).astype(np.float32)
+    ref_d, ref_i = _oracle(db, queries, 10)
+    # coarse candidates: the true top-30 shuffled (any superset works)
+    _, cand = _oracle(db, queries, 30)
+    perm = rng.permutation(30)
+    d, i = refine_exact(db, queries, cand[:, perm], 10)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-12)
+
+
+def test_refine_handles_duplicates_and_sentinels(rng):
+    db = rng.normal(size=(50, 4)).astype(np.float32)
+    queries = rng.normal(size=(3, 4)).astype(np.float32)
+    ref_d, ref_i = _oracle(db, queries, 5)
+    _, cand = _oracle(db, queries, 8)
+    cand = np.concatenate(
+        [cand, cand[:, :2], np.full((3, 2), 1 << 30, dtype=np.int64)], axis=-1
+    )
+    d, i = refine_exact(db, queries, cand, 5)
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_refine_l1_metric(rng):
+    db = rng.normal(size=(200, 8)).astype(np.float32)
+    queries = rng.normal(size=(7, 8)).astype(np.float32)
+    ref_d, ref_i = _oracle(db, queries, 4, "l1")
+    _, cand = _oracle(db, queries, 12, "l1")
+    d, i = refine_exact(db, queries, cand, 4, metric="l1")
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_refine_ties_break_to_lower_index(rng):
+    db = rng.normal(size=(40, 4)).astype(np.float32)
+    db[20:] = db[:20]  # exact duplicates: ties must go to the lower index
+    queries = db[:5].copy()
+    cand = np.tile(np.arange(40), (5, 1))
+    _, i = refine_exact(db, queries, cand, 3)
+    # nearest must be the query itself at its low index, not the duplicate
+    np.testing.assert_array_equal(i[:, 0], np.arange(5))
+
+
+def test_refine_rejects_too_few_candidates(rng):
+    db = rng.normal(size=(10, 3)).astype(np.float32)
+    q = rng.normal(size=(2, 3)).astype(np.float32)
+    with pytest.raises(ValueError, match="candidates"):
+        refine_exact(db, q, np.zeros((2, 3), dtype=np.int64), 5)
