@@ -1,0 +1,116 @@
+"""Checkpoint/resume streaming tests: interruption loses at most one batch,
+resume completes without recomputing finished work, wrong-run checkpoints
+are rejected."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.ops.topk import knn_search
+from knn_tpu.parallel import make_mesh
+from knn_tpu.streaming import StreamingSearch, _fingerprint, streaming_knn
+
+import jax.numpy as jnp
+
+
+@pytest.fixture
+def data(rng):
+    db = rng.normal(size=(300, 12)).astype(np.float32)
+    queries = rng.normal(size=(70, 12)).astype(np.float32)
+    return db, queries
+
+
+def _ref(db, queries, k):
+    d, i = knn_search(jnp.asarray(queries), jnp.asarray(db), k)
+    return np.asarray(d), np.asarray(i)
+
+
+def test_streaming_matches_direct(tmp_path, data):
+    db, queries = data
+    d, i = streaming_knn(
+        db, queries, 5, str(tmp_path / "ckpt"), mesh=make_mesh(4, 2), batch_size=16
+    )
+    ref_d, ref_i = _ref(db, queries, 5)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-5, atol=1e-5)
+
+
+def test_streaming_resumes_after_interruption(tmp_path, data):
+    db, queries = data
+    ckpt = str(tmp_path / "ckpt")
+    calls = []
+
+    def flaky(chunk):
+        calls.append(1)
+        if len(calls) == 3:
+            raise KeyboardInterrupt  # simulated preemption, not retried
+        return _ref(db, chunk, 5)
+
+    stream = StreamingSearch(flaky, 5, ckpt, batch_size=16, max_retries=0)
+    with pytest.raises(KeyboardInterrupt):
+        stream.run(queries)
+    st = stream.state(queries.shape[0])
+    assert len(st.done) == 2 and not st.complete  # two batches survived
+
+    # resume with a healthy fn: only the remaining 3 of 5 batches run
+    calls2 = []
+
+    def healthy(chunk):
+        calls2.append(1)
+        return _ref(db, chunk, 5)
+
+    stream2 = StreamingSearch(healthy, 5, ckpt, batch_size=16)
+    d, i = stream2.run(queries)
+    assert len(calls2) == 3
+    ref_d, ref_i = _ref(db, queries, 5)
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_streaming_retries_transient_failures(tmp_path, data):
+    db, queries = data
+    fails = {"left": 2}
+
+    def transient(chunk):
+        if fails["left"]:
+            fails["left"] -= 1
+            raise RuntimeError("simulated device loss")
+        return _ref(db, chunk, 4)
+
+    stream = StreamingSearch(transient, 4, str(tmp_path / "c"), batch_size=70, max_retries=2)
+    d, i = stream.run(queries)
+    ref_d, ref_i = _ref(db, queries, 4)
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_streaming_exhausted_retries_raise(tmp_path, data):
+    db, queries = data
+
+    def always_fails(chunk):
+        raise RuntimeError("dead device")
+
+    stream = StreamingSearch(always_fails, 4, str(tmp_path / "c"), batch_size=70, max_retries=1)
+    with pytest.raises(RuntimeError, match="after 2 attempts"):
+        stream.run(queries)
+
+
+def test_streaming_rejects_wrong_run(tmp_path, data):
+    db, queries = data
+    ckpt = str(tmp_path / "ckpt")
+    streaming_knn(db, queries, 5, ckpt, mesh=make_mesh(8, 1), batch_size=16)
+    with pytest.raises(ValueError, match="different run"):
+        streaming_knn(db, queries, 7, ckpt, mesh=make_mesh(8, 1), batch_size=16)
+    other_db = db + 1.0
+    with pytest.raises(ValueError, match="different run"):
+        streaming_knn(other_db, queries, 5, ckpt, mesh=make_mesh(8, 1), batch_size=16)
+
+
+def test_streaming_incomplete_assemble_raises(tmp_path, data):
+    db, queries = data
+    stream = StreamingSearch(lambda c: _ref(db, c, 3), 3, str(tmp_path / "c"), batch_size=16)
+    with pytest.raises(RuntimeError, match="incomplete"):
+        stream.assemble(queries.shape[0])
+
+
+def test_fingerprint_sensitivity(data):
+    db, _ = data
+    assert _fingerprint(db) != _fingerprint(db + 1e-3)
+    assert _fingerprint(db) == _fingerprint(db.copy())
