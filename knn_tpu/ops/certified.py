@@ -1,0 +1,166 @@
+"""Certified-exact KNN: an approximate coarse pass made *provably* exact.
+
+The exact tiled path (ops.topk.knn_search_tiled) is selection-bound: the
+distance matmul is ~1% of its runtime, the per-tile ``lax.top_k`` the rest.
+TPU hardware has a much faster selector — the bin-reduction behind
+``lax.approx_max_k`` (the XLA ApproxTopK op; see the TPU-KNN paper in
+PAPERS.md) — but it can *miss* true neighbors, and a miss is invisible to
+two-phase refinement (ops.refine can only reorder candidates it was given).
+
+This module closes the gap with a certificate:
+
+1. **coarse**: approx_max_k fetches k + margin candidates per query at
+   near-MXU speed;
+2. **refine**: ops.refine re-scores candidates in float64 → provisional
+   exact top-k and its kth distance d_k;
+3. **certify**: one more matmul-bound pass counts, per query, the database
+   points with float32 distance < d_k + tol, where tol bounds the float32
+   distance error.  If the count is exactly k, every point at true
+   distance <= d_k is already among the candidates (a missed one would
+   raise the count) — the result is certified exact;
+4. **fallback**: queries failing certification (misses OR tol false
+   alarms) rerun through the exact tiled path.  Soundness never depends on
+   the false-alarm rate; only speed does.
+
+Net effect: exact results (recall@k = 1.0 by construction) at the
+approximate path's throughput, with a fallback whose cost scales with the
+actual miss/alarm rate instead of the worst case.
+
+The reference has no analogue — its selection is a full std::sort per
+query (knn_mpi.cpp:323,366); this replaces it with MXU-speed selection
+plus a proof.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from knn_tpu.ops.refine import refine_exact
+from knn_tpu.ops.topk import knn_search_tiled
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def count_below(
+    db: jax.Array, queries: jax.Array, thresholds: jax.Array, *, tile: int = 131072
+) -> jax.Array:
+    """Per query, how many database rows have squared-L2 distance strictly
+    below the query's threshold — one matmul-bound pass, no selection.
+
+    [Q] int32.  Distances are computed exactly like the fast path
+    (float32 expanded square), so thresholds must already include any
+    tolerance the caller wants.
+    """
+    n = db.shape[0]
+    n_tiles = -(-n // tile)
+    padded = n_tiles * tile
+    if padded != n:
+        db = jnp.pad(db, ((0, padded - n), (0, 0)))
+    tiles = db.reshape(n_tiles, tile, db.shape[-1])
+
+    q32 = queries.astype(jnp.float32)
+    q_norm = jnp.sum(q32 * q32, axis=-1, keepdims=True)
+    thr = thresholds[:, None].astype(jnp.float32)
+
+    def step(acc, args):
+        tile_idx, t = args
+        t32 = t.astype(jnp.float32)
+        t_norm = jnp.sum(t32 * t32, axis=-1)[None, :]
+        qt = lax.dot_general(
+            q32, t32, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
+        )
+        d = jnp.maximum(q_norm + t_norm - 2.0 * qt, 0.0)
+        col = tile_idx * tile + lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+        hit = (d < thr) & (col < n)
+        return acc + jnp.sum(hit.astype(jnp.int32), axis=-1), None
+
+    acc0 = jnp.zeros(queries.shape[0], dtype=jnp.int32)
+    acc, _ = lax.scan(step, acc0, (jnp.arange(n_tiles, dtype=jnp.int32), tiles))
+    return acc
+
+
+def _approx_candidates(
+    queries: jax.Array, db: jax.Array, m: int, *, compute_dtype=None,
+    recall_target: float = 0.99,
+) -> jax.Array:
+    """[Q, m] candidate indices from the hardware bin-reduction selector
+    (ops.topk.knn_search_approx: MIPS-form squared L2 + approx_max_k)."""
+    from knn_tpu.ops.topk import knn_search_approx
+
+    _, idx = knn_search_approx(
+        queries, db, m,
+        recall_target=recall_target,
+        compute_dtype=jnp.float32 if compute_dtype is None else compute_dtype,
+    )
+    return idx
+
+
+#: float32 squared-distance error bound factor: |err| <~ eps * (||q||^2+||t||^2)
+#: with a safety factor for the matmul reduction tree.
+_F32_EPS = float(np.finfo(np.float32).eps)
+
+
+def knn_search_certified(
+    queries,
+    db,
+    k: int,
+    *,
+    margin: int = 28,
+    tile: int = 131072,
+    compute_dtype=None,
+    recall_target: float = 0.99,
+    candidate_fn=None,
+) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Exact lexicographic (distance, index) top-k via the certified
+    approximate pipeline.  Returns (dists_f64 [Q, k], idx [Q, k], stats).
+
+    ``candidate_fn(queries, db, m) -> [Q, m] indices`` overrides the coarse
+    pass (e.g. with the Pallas bin-min kernel, ops.pallas_knn); default is
+    the ApproxTopK selector.
+
+    ``stats`` reports ``fallback_queries`` — how many queries failed
+    certification and reran exactly (0 in the common case; correctness
+    never depends on it).
+    """
+    queries_np = np.asarray(queries, dtype=np.float32)
+    db_np = np.asarray(db, dtype=np.float32)
+    n_q = queries_np.shape[0]
+    n = db_np.shape[0]
+    if k > n:
+        raise ValueError(f"k={k} > n_db={n}")
+    m = min(k + margin, n)
+
+    q_j = jnp.asarray(queries_np)
+    db_j = jnp.asarray(db_np)
+
+    if candidate_fn is None:
+        cand = _approx_candidates(
+            q_j, db_j, m, compute_dtype=compute_dtype, recall_target=recall_target
+        )
+    else:
+        cand = candidate_fn(q_j, db_j, m)
+    d, i = refine_exact(db_np, queries_np, np.asarray(cand), k)
+
+    # certification threshold: kth true distance plus the f32 error bound
+    q_norm = (queries_np.astype(np.float64) ** 2).sum(-1)
+    db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
+    tol = 8.0 * _F32_EPS * (q_norm + db_norm_max)
+    thresholds = d[:, k - 1] + tol
+    counts = np.asarray(count_below(db_j, q_j, jnp.asarray(thresholds), tile=tile))
+
+    bad = np.flatnonzero(counts > k)
+    if bad.size:
+        # fetch k+margin here too: the tiled pass ranks in float32, so the
+        # refine step needs the same boundary slack as the coarse pass
+        _, fi = knn_search_tiled(
+            q_j[bad], db_j, m, "l2", train_tile=min(tile, n),
+        )
+        fd2, fi2 = refine_exact(db_np, queries_np[bad], np.asarray(fi), k)
+        d[bad], i[bad] = fd2, fi2
+    return d, i, {"fallback_queries": int(bad.size), "certified": n_q - int(bad.size)}

@@ -1,0 +1,198 @@
+"""Pallas TPU kernel: fused distance + bin-min candidate generation.
+
+The hot loop of the whole framework is ``query x database`` distance +
+neighbor selection (the reference burns it in a scalar loop + full sort,
+knn_mpi.cpp:317-323).  The XLA path (ops.topk) is already matmul-based but
+selection-bound: ``lax.top_k`` over wide tiles dominates the runtime.
+This kernel fuses the two so the distance tile never round-trips to HBM:
+
+  per grid cell (query block i, db tile j):
+    1. MXU:  qt = Q_i @ T_j^T            (bf16 inputs, f32 accumulate)
+    2. VPU:  d  = ||t||^2 - 2 qt         (+||q||^2 dropped: per-query
+                                          constant, rank-invariant)
+    3. VPU:  per 128-wide bin, min + argmin  ->  [BQ, L] candidates
+
+Only L candidates per tile leave VMEM (L = tile/128), a ~128x reduction in
+HBM writes vs materializing the distance matrix.  The candidates then go
+through one *small* device-side lexicographic top-m, and exactness is
+restored by the certified pipeline (ops.certified: float64 refine +
+count-below certificate + exact fallback) — the kernel itself only has to
+be *probably* right, never wrong silently.
+
+This is the same shape as the ApproxTopK/PartialReduce design (TPU-KNN
+paper, PAPERS.md) but as an explicit Pallas kernel: the bin reduction
+fuses with the distance computation instead of running on a materialized
+score matrix.
+
+Runs in interpret mode off-TPU so the CPU test suite covers it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable off-TPU; guard anyway for exotic builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from knn_tpu.ops.topk import topk_pairs
+
+#: query rows per grid cell (MXU-aligned)
+BLOCK_Q = 256
+#: database rows per grid cell; VMEM cost ~ BLOCK_Q*TILE_N*4B for the
+#: distance tile (2 MB at 256 x 2048)
+TILE_N = 2048
+#: bin width — one candidate survives per bin (lane-aligned)
+BIN_W = 128
+
+
+def _kernel(q_ref, t_ref, d_ref, i_ref, *, n_valid: int, tile_n: int,
+            compute_dtype):
+    j = pl.program_id(1)
+    q = q_ref[:]
+    t = t_ref[:]
+    t32 = t.astype(jnp.float32)
+    t_norm = jnp.sum(t32 * t32, axis=-1)[None, :]  # [1, T]
+    qt = lax.dot_general(
+        q.astype(compute_dtype),
+        t.astype(compute_dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [BQ, T]
+    d = t_norm - 2.0 * qt  # rank-equivalent to squared L2 (||q||^2 dropped)
+
+    # mask db padding rows (global col >= n_valid) out of every bin
+    col = j * tile_n + lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    d = jnp.where(col < n_valid, d, jnp.inf)
+
+    bq = d.shape[0]
+    n_bins = tile_n // BIN_W
+    d3 = d.reshape(bq, n_bins, BIN_W)
+    bin_min = jnp.min(d3, axis=-1)  # [BQ, L]
+    bin_arg = jnp.argmin(d3, axis=-1).astype(jnp.int32)  # [BQ, L]
+    base = j * tile_n + lax.broadcasted_iota(jnp.int32, bin_min.shape, 1) * BIN_W
+    d_ref[:] = bin_min
+    i_ref[:] = base + bin_arg
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "tile_n", "compute_dtype", "interpret")
+)
+def _bin_candidates(
+    queries: jax.Array,
+    db: jax.Array,
+    *,
+    block_q: int,
+    tile_n: int,
+    compute_dtype,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Padded-shape kernel launch: ([Qp, C] bin-min scores, [Qp, C] global
+    indices), C = (Np/tile_n) * (tile_n/BIN_W).  Scores are squared L2
+    minus ||q||^2 (per-query constant), so per-query ranking is intact."""
+    n_valid = db.shape[0]
+    qp = -(-queries.shape[0] // block_q) * block_q
+    np_ = -(-db.shape[0] // tile_n) * tile_n
+    if qp != queries.shape[0]:
+        queries = jnp.pad(queries, ((0, qp - queries.shape[0]), (0, 0)))
+    if np_ != db.shape[0]:
+        db = jnp.pad(db, ((0, np_ - db.shape[0]), (0, 0)))
+    n_tiles = np_ // tile_n
+    n_bins = tile_n // BIN_W
+    dim = queries.shape[1]
+
+    kernel = functools.partial(
+        _kernel, n_valid=n_valid, tile_n=tile_n, compute_dtype=compute_dtype
+    )
+    grid = (qp // block_q, n_tiles)
+    mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
+    d, i = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, dim), lambda qi, ti: (qi, 0), **mem),
+            pl.BlockSpec((tile_n, dim), lambda qi, ti: (ti, 0), **mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_q, n_bins), lambda qi, ti: (qi, ti), **mem),
+            pl.BlockSpec((block_q, n_bins), lambda qi, ti: (qi, ti), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((qp, n_tiles * n_bins), jnp.float32),
+            jax.ShapeDtypeStruct((qp, n_tiles * n_bins), jnp.int32),
+        ],
+        interpret=interpret,
+    )(queries, db)
+    return d, i
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def pallas_knn_candidates(
+    queries: jax.Array,
+    db: jax.Array,
+    m: int,
+    *,
+    block_q: int = BLOCK_Q,
+    tile_n: int = TILE_N,
+    compute_dtype=jnp.bfloat16,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """[Q, m] coarse candidate indices: fused bin-min kernel + one small
+    lexicographic top-m over the surviving candidates.
+
+    Plug into ops.certified.knn_search_certified as ``candidate_fn`` for
+    guaranteed-exact results at kernel speed.  A bin holds BIN_W=128 db
+    rows and emits one survivor, so two true top-k members in one bin cost
+    a (certified, fallback-corrected) miss — margin and certification make
+    that a speed question, not a correctness one.
+    """
+    if tile_n % BIN_W:
+        raise ValueError(f"tile_n={tile_n} must be a multiple of {BIN_W}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    n_q = queries.shape[0]
+    d, i = _bin_candidates(
+        queries, db, block_q=block_q, tile_n=tile_n,
+        compute_dtype=jnp.dtype(compute_dtype).name, interpret=interpret,
+    )
+    n_cand = d.shape[1]
+    if m > n_cand:
+        raise ValueError(
+            f"m={m} exceeds {n_cand} bin candidates; lower tile_n or raise margin"
+        )
+    _, idx = topk_pairs(d[:n_q], i[:n_q], m)
+    return idx
+
+
+def knn_search_pallas(
+    queries,
+    db,
+    k: int,
+    *,
+    margin: int = 28,
+    tile_n: int = TILE_N,
+    compute_dtype=jnp.bfloat16,
+):
+    """Certified-exact KNN with the Pallas kernel as the coarse pass:
+    (dists_f64 [Q, k], idx [Q, k], stats).  See ops.certified."""
+    from knn_tpu.ops.certified import knn_search_certified
+
+    return knn_search_certified(
+        queries, db, k, margin=margin,
+        candidate_fn=functools.partial(
+            pallas_knn_candidates, tile_n=tile_n, compute_dtype=compute_dtype
+        ),
+    )

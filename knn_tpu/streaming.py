@@ -66,6 +66,7 @@ class StreamingSearch:
         *,
         batch_size: int = 512,
         db_fingerprint: Optional[str] = None,
+        search_config: Optional[dict] = None,
         max_retries: int = 2,
     ):
         self._fn = search_fn
@@ -73,6 +74,10 @@ class StreamingSearch:
         self.dir = checkpoint_dir
         self.batch_size = batch_size
         self.fingerprint = db_fingerprint
+        #: JSON-serializable echo of the search configuration (metric,
+        #: dtype, merge, ...) — part of the resume guard, because finished
+        #: batches computed under a different config are silently wrong
+        self.search_config = search_config or {}
         self.max_retries = max_retries
         os.makedirs(self.dir, exist_ok=True)
 
@@ -80,17 +85,19 @@ class StreamingSearch:
     def _manifest_path(self) -> str:
         return os.path.join(self.dir, self.MANIFEST)
 
-    def _expected_manifest(self, n_queries: int) -> dict:
+    def _expected_manifest(self, queries: np.ndarray) -> dict:
         return {
-            "n_queries": n_queries,
+            "n_queries": int(queries.shape[0]),
+            "query_fingerprint": _fingerprint(queries),
             "batch_size": self.batch_size,
             "k": self.k,
             "db_fingerprint": self.fingerprint,
+            "search_config": self.search_config,
         }
 
-    def _check_manifest(self, n_queries: int) -> None:
+    def _check_manifest(self, queries: np.ndarray) -> None:
         path = self._manifest_path()
-        expected = self._expected_manifest(n_queries)
+        expected = self._expected_manifest(queries)
         if os.path.exists(path):
             with open(path) as f:
                 found = json.load(f)
@@ -138,7 +145,7 @@ class StreamingSearch:
         (dists [Q, k], idx [Q, k])."""
         queries = np.asarray(queries)
         n = queries.shape[0]
-        self._check_manifest(n)
+        self._check_manifest(queries)
         st = self.state(n)
         done = set(st.done)
         for b in range(st.n_batches):
@@ -202,6 +209,12 @@ def streaming_knn(
     stream = StreamingSearch(
         program.search, k, checkpoint_dir,
         batch_size=batch_size, db_fingerprint=_fingerprint(db),
+        search_config={
+            "metric": metric,
+            "merge": merge,
+            "train_tile": train_tile,
+            "compute_dtype": None if compute_dtype is None else str(compute_dtype),
+        },
         max_retries=max_retries,
     )
     return stream.run(queries)

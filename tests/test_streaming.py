@@ -103,6 +103,19 @@ def test_streaming_rejects_wrong_run(tmp_path, data):
         streaming_knn(other_db, queries, 5, ckpt, mesh=make_mesh(8, 1), batch_size=16)
 
 
+def test_streaming_rejects_different_queries_or_metric(tmp_path, data):
+    # same shapes, different content/config: must NOT silently reuse batches
+    db, queries = data
+    ckpt = str(tmp_path / "ckpt")
+    streaming_knn(db, queries, 5, ckpt, mesh=make_mesh(8, 1), batch_size=16)
+    other_queries = queries + 0.5
+    with pytest.raises(ValueError, match="different run"):
+        streaming_knn(db, other_queries, 5, ckpt, mesh=make_mesh(8, 1), batch_size=16)
+    with pytest.raises(ValueError, match="different run"):
+        streaming_knn(db, queries, 5, ckpt, mesh=make_mesh(8, 1), batch_size=16,
+                      metric="cosine")
+
+
 def test_streaming_incomplete_assemble_raises(tmp_path, data):
     db, queries = data
     stream = StreamingSearch(lambda c: _ref(db, c, 3), 3, str(tmp_path / "c"), batch_size=16)
