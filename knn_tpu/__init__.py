@@ -52,6 +52,13 @@ _EXPORTS = {
     "JobResult": "knn_tpu.pipeline",
     "ShardedKNN": "knn_tpu.parallel.sharded",
     "make_mesh": "knn_tpu.parallel.mesh",
+    "knn_search_certified": "knn_tpu.ops.certified",
+    "count_below": "knn_tpu.ops.certified",
+    "refine_exact": "knn_tpu.ops.refine",
+    "knn_search_pallas": "knn_tpu.ops.pallas_knn",
+    "pallas_knn_candidates": "knn_tpu.ops.pallas_knn",
+    "StreamingSearch": "knn_tpu.streaming",
+    "streaming_knn": "knn_tpu.streaming",
 }
 
 __all__ = sorted(_EXPORTS) + ["__version__"]

@@ -43,6 +43,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--train-tile", type=int, default=None, help="HBM tile rows for the streamed distance matrix")
     p.add_argument("--batch-size", type=int, default=None, help="queries per device step")
     p.add_argument("--compute-dtype", default=None, help="matmul dtype, e.g. bfloat16")
+    p.add_argument(
+        "--mode", default="exact", choices=("exact", "certified"),
+        help="certified = fast approximate selection + float64 refinement + "
+        "count-below certificate (exact results, l2 only)",
+    )
+    p.add_argument(
+        "--selector", default="approx", choices=("exact", "approx", "pallas"),
+        help="local-shard selector for --mode certified",
+    )
     p.add_argument("--num-threads", type=int, default=0, help="native backend threads (0 = all cores)")
     p.add_argument("--metrics-json", default=None, help="write structured run metrics to this path")
     p.add_argument(
@@ -75,6 +84,8 @@ def args_to_config(args: argparse.Namespace) -> JobConfig:
         train_tile=args.train_tile,
         batch_size=args.batch_size,
         compute_dtype=args.compute_dtype,
+        mode=args.mode,
+        selector=args.selector,
         num_threads=args.num_threads,
     )
 

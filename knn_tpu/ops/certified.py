@@ -47,16 +47,25 @@ from knn_tpu.ops.topk import knn_search_tiled
 
 @functools.partial(jax.jit, static_argnames=("tile",))
 def count_below(
-    db: jax.Array, queries: jax.Array, thresholds: jax.Array, *, tile: int = 131072
+    db: jax.Array,
+    queries: jax.Array,
+    thresholds: jax.Array,
+    *,
+    tile: int = 131072,
+    n_valid=None,
 ) -> jax.Array:
     """Per query, how many database rows have squared-L2 distance strictly
     below the query's threshold — one matmul-bound pass, no selection.
 
     [Q] int32.  Distances are computed exactly like the fast path
     (float32 expanded square), so thresholds must already include any
-    tolerance the caller wants.
+    tolerance the caller wants.  Rows at index >= ``n_valid`` (may be
+    traced) are padding and never counted — the db-shard contract shared
+    with ops.topk.knn_search.
     """
     n = db.shape[0]
+    tile = min(tile, n)  # never pad a small db up to a full default tile
+    limit = n if n_valid is None else jnp.minimum(n, n_valid)
     n_tiles = -(-n // tile)
     padded = n_tiles * tile
     if padded != n:
@@ -77,7 +86,7 @@ def count_below(
         )
         d = jnp.maximum(q_norm + t_norm - 2.0 * qt, 0.0)
         col = tile_idx * tile + lax.broadcasted_iota(jnp.int32, (1, tile), 1)
-        hit = (d < thr) & (col < n)
+        hit = (d < thr) & (col < limit)
         return acc + jnp.sum(hit.astype(jnp.int32), axis=-1), None
 
     acc0 = jnp.zeros(queries.shape[0], dtype=jnp.int32)
@@ -104,6 +113,14 @@ def _approx_candidates(
 #: float32 squared-distance error bound factor: |err| <~ eps * (||q||^2+||t||^2)
 #: with a safety factor for the matmul reduction tree.
 _F32_EPS = float(np.finfo(np.float32).eps)
+
+
+def certification_tolerance(queries_np: np.ndarray, db_np: np.ndarray) -> np.ndarray:
+    """Per-query additive slack [Q] covering the float32 distance error in
+    the certificate's count pass (see module docstring, step 3)."""
+    q_norm = (queries_np.astype(np.float64) ** 2).sum(-1)
+    db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
+    return 8.0 * _F32_EPS * (q_norm + db_norm_max)
 
 
 def knn_search_certified(
@@ -148,10 +165,7 @@ def knn_search_certified(
     d, i = refine_exact(db_np, queries_np, np.asarray(cand), k)
 
     # certification threshold: kth true distance plus the f32 error bound
-    q_norm = (queries_np.astype(np.float64) ** 2).sum(-1)
-    db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
-    tol = 8.0 * _F32_EPS * (q_norm + db_norm_max)
-    thresholds = d[:, k - 1] + tol
+    thresholds = d[:, k - 1] + certification_tolerance(queries_np, db_np)
     counts = np.asarray(count_below(db_j, q_j, jnp.asarray(thresholds), tile=tile))
 
     bad = np.flatnonzero(counts > k)

@@ -177,6 +177,39 @@ def pallas_knn_candidates(
     return idx
 
 
+def local_bin_topk(
+    q: jax.Array,
+    t: jax.Array,
+    k: int,
+    *,
+    compute_dtype=None,
+    tile_n: int = TILE_N,
+) -> Tuple[jax.Array, jax.Array]:
+    """Shard-local coarse top-k for parallel.sharded's "pallas" selector:
+    (scores [Q, k], local indices [Q, k]).
+
+    Scores are squared L2 minus the per-query ``||q||^2`` constant —
+    rank-consistent across db shards for the same query, so the sharded
+    lexicographic merge composes.  One candidate survives per BIN_W=128
+    rows, so k must not exceed shard_rows/BIN_W; callable inside
+    shard_map (one kernel launch per device).
+    """
+    if compute_dtype is None:
+        compute_dtype = jnp.bfloat16
+    eff_tile = min(tile_n, max(BIN_W, -(-t.shape[0] // BIN_W) * BIN_W))
+    d, i = _bin_candidates(
+        q, t, block_q=min(BLOCK_Q, max(8, q.shape[0])), tile_n=eff_tile,
+        compute_dtype=jnp.dtype(compute_dtype).name, interpret=not _on_tpu(),
+    )
+    n_cand = d.shape[1]
+    if k > n_cand:
+        raise ValueError(
+            f"pallas selector: k={k} exceeds {n_cand} bins "
+            f"(shard rows / {BIN_W}); use the exact or approx selector"
+        )
+    return topk_pairs(d[: q.shape[0]], i[: q.shape[0]], k)
+
+
 def knn_search_pallas(
     queries,
     db,

@@ -155,10 +155,12 @@ def knn_search_approx(
     *,
     recall_target: float = 0.95,
     compute_dtype=None,
+    n_valid=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Approximate L2 KNN via ``lax.approx_max_k`` — the recall-vs-speed knob
     (SURVEY.md §7 step 6).  L2 only: uses the -||t||^2 + 2 q.t^T MIPS score
-    so approx_max_k's aggregate-to-topk path applies."""
+    so approx_max_k's aggregate-to-topk path applies.  ``n_valid`` (may be
+    traced) masks trailing padding rows out of the candidate set."""
     t32 = train.astype(jnp.float32)
     half_t_norm = 0.5 * jnp.sum(t32 * t32, axis=-1)[None, :]
     if compute_dtype is None:
@@ -170,6 +172,9 @@ def knn_search_approx(
         preferred_element_type=jnp.float32,
     )
     score = qt - half_t_norm  # argmax_t score == argmin_t ||q-t||^2
+    if n_valid is not None:
+        cols = lax.broadcasted_iota(jnp.int32, (1, train.shape[0]), 1)
+        score = jnp.where(cols < n_valid, score, -jnp.inf)
     neg_half, idx = lax.approx_max_k(score, k, recall_target=recall_target)
     q32 = queries.astype(jnp.float32)
     q_norm = jnp.sum(q32 * q32, axis=-1, keepdims=True)

@@ -76,28 +76,51 @@ def _allgather_merge(d, i, k: int, axis_name: str):
 
 _MERGES = ("allgather", "ring")
 
+#: Local-shard neighbor selectors.  "exact" ranks every row (float32
+#: lexicographic top-k); "approx" uses the hardware bin-reduction behind
+#: lax.approx_max_k; "pallas" uses the fused distance+bin-min kernel
+#: (ops.pallas_knn).  The approximate selectors are for the *certified*
+#: path (search_certified), where misses are detected and repaired.
+SELECTORS = ("exact", "approx", "pallas")
 
-def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype):
+
+def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector):
     """Local shard top-k with global train indices.
 
     The last db shard may contain zero-padding rows; their distances are
-    forced to +inf *inside* the selection (``n_valid``) so a pad row can
-    never displace a real neighbor from the local top-k.
+    forced to +inf *inside* the exact/approx selection (``n_valid``) so a
+    pad row can never displace a real neighbor.  The pallas selector masks
+    after its bin reduction — a pad row can then shadow one bin of the
+    last shard, which the certified pipeline detects and repairs.
     """
     db_idx = lax.axis_index(DB_AXIS)
     n_local_valid = jnp.clip(n_train - db_idx * t.shape[0], 0, t.shape[0])
-    d, i = knn_search_tiled(
-        q, t, k, metric, train_tile=train_tile, compute_dtype=compute_dtype,
-        n_valid=n_local_valid,
-    )
+    if selector == "exact":
+        d, i = knn_search_tiled(
+            q, t, k, metric, train_tile=train_tile, compute_dtype=compute_dtype,
+            n_valid=n_local_valid,
+        )
+    elif selector == "approx":
+        from knn_tpu.ops.topk import knn_search_approx
+
+        d, i = knn_search_approx(
+            q, t, k, compute_dtype=compute_dtype, n_valid=n_local_valid
+        )
+    elif selector == "pallas":
+        from knn_tpu.ops.pallas_knn import local_bin_topk
+
+        d, i = local_bin_topk(q, t, k, compute_dtype=compute_dtype)
+    else:
+        raise ValueError(f"unknown selector {selector!r}; expected one of {SELECTORS}")
     pad = i >= n_local_valid
     gi = jnp.where(pad, _INT_SENTINEL, i + db_idx * t.shape[0])
     return jnp.where(pad, jnp.inf, d), gi
 
 
-def _merged_topk(q, t, k, metric, merge, n_train, train_tile, compute_dtype, db_shards):
+def _merged_topk(q, t, k, metric, merge, n_train, train_tile, compute_dtype,
+                 db_shards, selector="exact"):
     """Shared SPMD body: local shard top-k, then merge across the db axis."""
-    d, gi = _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype)
+    d, gi = _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector)
     if db_shards > 1:
         if merge == "ring":
             d, gi = _ring_merge(d, gi, k, DB_AXIS, db_shards)
@@ -115,12 +138,14 @@ def _knn_program(
     n_train: int,
     train_tile: Optional[int],
     compute_dtype,
+    selector: str = "exact",
 ):
     db_shards = mesh.shape[DB_AXIS]
 
     def spmd(q, t):
         return _merged_topk(
-            q, t, k, metric, merge, n_train, train_tile, compute_dtype, db_shards
+            q, t, k, metric, merge, n_train, train_tile, compute_dtype,
+            db_shards, selector,
         )
 
     return jax.jit(
@@ -164,6 +189,8 @@ class ShardedKNN:
         db_shards = mesh.shape[DB_AXIS]
         if not isinstance(train, jax.Array):
             train = np.asarray(train)  # keep on host; padding + placement stream shards
+        # host copy (unpadded) for certified-path float64 refinement
+        self._train_host = train if isinstance(train, np.ndarray) else None
         tp, n_train = pad_to_multiple(train, db_shards)
         shard_rows = tp.shape[0] // db_shards
         if k > shard_rows:
@@ -209,6 +236,90 @@ class ShardedKNN:
         )
         d, i = fn(qp, self._tp)
         return d[:n_q], i[:n_q]
+
+    # -- certified-exact path (ops.certified, distributed) -----------------
+    def _host_train(self) -> np.ndarray:
+        """Host copy of the (unpadded) database for float64 refinement;
+        fetched from the mesh once and cached when the caller didn't keep
+        a host array around."""
+        if self._train_host is None:
+            self._train_host = np.asarray(self._tp)[: self.n_train]
+        return self._train_host
+
+    def search_certified(
+        self, queries, *, margin: int = 28, selector: str = "approx"
+    ):
+        """Exact lexicographic top-k via the certified pipeline, sharded:
+        coarse top-(k+margin) with a fast selector, float64 host refine,
+        distributed count-below certificate (psum over the db axis), exact
+        fallback for flagged queries.  Returns (dists_f64, idx, stats).
+        L2 only (the certificate threshold is a squared-L2 bound)."""
+        if self.metric not in ("l2", "sql2", "euclidean"):
+            raise ValueError("search_certified supports the l2 metric only")
+        if selector not in SELECTORS:
+            raise ValueError(f"unknown selector {selector!r}; expected {SELECTORS}")
+        from knn_tpu.ops.certified import certification_tolerance
+        from knn_tpu.ops.refine import refine_exact
+
+        q_np = np.asarray(queries, dtype=np.float32)
+        n_q = q_np.shape[0]
+        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        # margin is bounded by both the db size and the per-shard rows the
+        # coarse/fallback programs select from (k itself fits: __init__
+        # checks k <= shard_rows)
+        m = min(self.k + margin, self.n_train, shard_rows)
+        if selector == "pallas":
+            # one candidate survives per 128-row bin, capping the margin
+            from knn_tpu.ops.pallas_knn import BIN_W
+
+            m = min(m, max(self.k, shard_rows // BIN_W))
+        qp, _ = self._place_queries(q_np)
+        coarse = _knn_program(
+            self.mesh, m, self.metric, self.merge, self.n_train,
+            self.train_tile, self._dtype_key, selector,
+        )
+        _, ci = coarse(qp, self._tp)
+        db_np = self._host_train()
+        d, i = refine_exact(db_np, q_np, np.asarray(ci)[:n_q], self.k)
+
+        thresholds = d[:, self.k - 1] + certification_tolerance(q_np, db_np)
+        thr_p = np.full(qp.shape[0], -np.inf, dtype=np.float32)
+        thr_p[:n_q] = thresholds
+        thr_p = jax.device_put(thr_p, NamedSharding(self.mesh, P(QUERY_AXIS)))
+        count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
+        counts = np.asarray(count_fn(qp, self._tp, thr_p))[:n_q]
+
+        bad = np.flatnonzero(counts > self.k)
+        if bad.size:
+            exact = _knn_program(
+                self.mesh, m, self.metric, self.merge, self.n_train,
+                self.train_tile, self._dtype_key, "exact",
+            )
+            bq, _ = self._place_queries(q_np[bad])
+            _, fi = exact(bq, self._tp)
+            fd2, fi2 = refine_exact(
+                db_np, q_np[bad], np.asarray(fi)[: bad.size], self.k
+            )
+            d[bad], i[bad] = fd2, fi2
+        return d, i, {
+            "fallback_queries": int(bad.size),
+            "certified": n_q - int(bad.size),
+        }
+
+    def predict_certified(
+        self, queries, *, margin: int = 28, selector: str = "approx"
+    ):
+        """Certified-exact classification: exact neighbor sets from
+        :meth:`search_certified`, then the reference vote (ops.vote).
+        Returns (labels [Q] int32, stats)."""
+        if self._labels is None:
+            raise RuntimeError("ShardedKNN built without labels; predict unavailable")
+        _, idx, stats = self.search_certified(
+            queries, margin=margin, selector=selector
+        )
+        labels_host = np.asarray(self._labels)
+        votes = majority_vote(jnp.asarray(labels_host[idx]), self.num_classes)
+        return np.asarray(votes), stats
 
     def predict(self, queries: jax.Array) -> jax.Array:
         """Predicted labels [Q] — requires ``labels`` at construction."""
@@ -303,6 +414,40 @@ def sharded_knn_predict(
         labels=train_labels, num_classes=num_classes,
     )
     return prog.predict(queries)
+
+
+@functools.lru_cache(maxsize=32)
+def _count_program(mesh: Mesh, n_train: int, train_tile: Optional[int]):
+    """Per-query count of db rows with squared-L2 distance strictly below
+    the query's threshold — the distributed certificate pass of
+    ops.certified (matmul-bound, no selection).  Counts psum over the db
+    axis; output replicated there."""
+    from knn_tpu.ops.certified import count_below
+
+    db_shards = mesh.shape[DB_AXIS]
+    tile = train_tile or 131072
+
+    def spmd(q, t, thr):
+        db_idx = lax.axis_index(DB_AXIS)
+        n_local_valid = jnp.clip(n_train - db_idx * t.shape[0], 0, t.shape[0])
+        # count within the local shard, masking padding rows via a
+        # +inf-threshold trick: rows >= n_local_valid can't be < thr
+        local = count_below.__wrapped__(
+            t, q, thr, tile=min(tile, t.shape[0]), n_valid=n_local_valid
+        )
+        if db_shards > 1:
+            local = lax.psum(local, DB_AXIS)
+        return local
+
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P(QUERY_AXIS)),
+            out_specs=P(QUERY_AXIS),
+            check_vma=False,
+        )
+    )
 
 
 @functools.lru_cache(maxsize=16)

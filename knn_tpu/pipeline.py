@@ -129,6 +129,8 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
             num_classes=num_classes,
         )
 
+    certified_stats = {"fallback_queries": 0, "certified": 0}
+
     def classify(queries):
         n = queries.shape[0]
         bs = cfg.batch_size or n
@@ -137,7 +139,18 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
             chunk = queries[start : start + bs]
             if chunk.shape[0] < bs:  # pad the tail so XLA sees one shape
                 chunk = np.pad(chunk, ((0, bs - chunk.shape[0]), (0, 0)))
-            out.append(np.asarray(program.predict(chunk))[: min(bs, n - start)])
+            take = min(bs, n - start)
+            if cfg.mode == "certified":
+                # real rows only: zero-pad queries would pollute the
+                # certificate stats (and can spuriously fall back)
+                labels_out, stats = program.predict_certified(
+                    chunk[:take], selector=cfg.selector
+                )
+                certified_stats["fallback_queries"] += stats["fallback_queries"]
+                certified_stats["certified"] += stats["certified"]
+                out.append(np.asarray(labels_out))
+            else:
+                out.append(np.asarray(program.predict(chunk))[:take])
         return np.concatenate(out)
 
     val_pred = None
@@ -146,7 +159,9 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
             val_pred = classify(val)
     with timer.phase("knn_test"):
         test_pred = classify(test)
-    return test_pred, val_pred
+    return test_pred, val_pred, (
+        certified_stats if cfg.mode == "certified" else None
+    )
 
 
 def _run_native(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,

@@ -58,6 +58,13 @@ class JobConfig:
     train_tile: Optional[int] = None
     batch_size: Optional[int] = None
     compute_dtype: Optional[str] = None
+    #: "exact" ranks every candidate in float32; "certified" uses a fast
+    #: approximate selector + float64 refinement + the count-below
+    #: certificate (ops.certified) — exact results, higher throughput at
+    #: scale.  Certified requires the l2 metric.
+    mode: str = "exact"
+    #: local-shard selector for certified mode: "approx" | "pallas" | "exact"
+    selector: str = "approx"
     # --- native backend knobs ---
     num_threads: int = 0  # 0 = hardware concurrency
 
@@ -70,6 +77,14 @@ class JobConfig:
             raise ValueError(f"k must be >= 1, got {self.k}")
         if self.validation and not self.val_file:
             raise ValueError("validation=True requires val_file")
+        if self.mode not in ("exact", "certified"):
+            raise ValueError(f"mode {self.mode!r} not in ('exact', 'certified')")
+        if self.selector not in ("exact", "approx", "pallas"):
+            raise ValueError(f"selector {self.selector!r} unknown")
+        if self.mode == "certified" and self.metric.lower() not in (
+            "l2", "sql2", "euclidean"
+        ):
+            raise ValueError("mode='certified' requires the l2 metric")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)

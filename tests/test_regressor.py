@@ -1,0 +1,60 @@
+"""KNNRegressor tests (capability extension over the reference, which only
+classifies — SURVEY.md §2)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from knn_tpu.models.regressor import KNNRegressor, knn_regress
+
+
+def test_uniform_weights_match_numpy(rng):
+    X = rng.normal(size=(120, 6)).astype(np.float32)
+    y = rng.normal(size=120).astype(np.float32)
+    Q = rng.normal(size=(15, 6)).astype(np.float32)
+    reg = KNNRegressor(k=7).fit(X, y)
+    pred = np.asarray(reg.predict(Q))
+    # numpy oracle
+    d = ((X.astype(np.float64)[None] - Q.astype(np.float64)[:, None]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=-1, kind="stable")[:, :7]
+    want = y[idx].mean(-1)
+    np.testing.assert_allclose(pred, want, rtol=1e-5, atol=1e-6)
+
+
+def test_distance_weights_interpolate(rng):
+    # exact hit: distance-weighted prediction must return that target
+    X = rng.normal(size=(50, 4)).astype(np.float32)
+    y = rng.normal(size=50).astype(np.float32)
+    reg = KNNRegressor(k=5, weights="distance").fit(X, y)
+    pred = np.asarray(reg.predict(X[:8]))
+    np.testing.assert_allclose(pred, y[:8], rtol=1e-3)
+
+
+def test_multioutput_targets(rng):
+    X = rng.normal(size=(60, 5)).astype(np.float32)
+    y = rng.normal(size=(60, 3)).astype(np.float32)
+    pred = np.asarray(KNNRegressor(k=4).fit(X, y).predict(X[:10]))
+    assert pred.shape == (10, 3)
+    d = ((X.astype(np.float64)[None] - X[:10].astype(np.float64)[:, None]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=-1, kind="stable")[:, :4]
+    np.testing.assert_allclose(pred, y[idx].mean(1), rtol=1e-5, atol=1e-6)
+
+
+def test_tiled_matches_untiled(rng):
+    X = rng.normal(size=(200, 8)).astype(np.float32)
+    y = rng.normal(size=200).astype(np.float32)
+    Q = rng.normal(size=(9, 8)).astype(np.float32)
+    a = np.asarray(KNNRegressor(k=6).fit(X, y).predict(Q))
+    b = np.asarray(KNNRegressor(k=6, train_tile=33).fit(X, y).predict(Q))
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_regressor_errors(rng):
+    X = rng.normal(size=(10, 3)).astype(np.float32)
+    y = rng.normal(size=10).astype(np.float32)
+    with pytest.raises(RuntimeError, match="fit"):
+        KNNRegressor(k=2).predict(X)
+    with pytest.raises(ValueError, match="k="):
+        KNNRegressor(k=11).fit(X, y)
+    with pytest.raises(ValueError, match="weights"):
+        knn_regress(jnp.asarray(X), jnp.asarray(y), jnp.asarray(X[:2]), k=2, weights="quadratic")

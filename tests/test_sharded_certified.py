@@ -1,0 +1,104 @@
+"""Sharded certified-exact path: coarse selector (approx/pallas/exact) on
+each db shard, lexicographic merge, float64 refine, distributed count-below
+certificate (psum over the db axis), exact fallback — must equal the
+float64 oracle on every mesh shape."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from knn_tpu.models.classifier import knn_predict
+from knn_tpu.parallel import ShardedKNN, make_mesh
+from knn_tpu.pipeline import run_job
+from knn_tpu.utils.config import JobConfig
+
+
+def _oracle(db, queries, k):
+    d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=-1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=-1), idx
+
+
+@pytest.fixture
+def data(rng):
+    db = rng.normal(size=(1100, 16)).astype(np.float32) * 10
+    db[500:550] = db[:50]  # ties across shard boundaries
+    queries = rng.normal(size=(37, 16)).astype(np.float32) * 10
+    return db, queries
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4), (1, 8)])
+@pytest.mark.parametrize("selector", ["approx", "exact"])
+def test_sharded_certified_matches_oracle(data, mesh_shape, selector):
+    db, queries = data
+    ref_d, ref_i = _oracle(db, queries, 7)
+    prog = ShardedKNN(db, mesh=make_mesh(*mesh_shape), k=7)
+    d, i, stats = prog.search_certified(queries, selector=selector)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-9)
+    assert stats["certified"] + stats["fallback_queries"] == queries.shape[0]
+
+
+def test_sharded_certified_pallas_selector(rng):
+    # pallas bins need >= k*BIN_W rows per shard: use a bigger db, 2 shards
+    db = rng.normal(size=(4 * 128 * 5, 8)).astype(np.float32)
+    queries = rng.normal(size=(16, 8)).astype(np.float32)
+    ref_d, ref_i = _oracle(db, queries, 4)
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=4)
+    d, i, stats = prog.search_certified(queries, selector="pallas")
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_predict_certified_matches_exact_predict(data):
+    db, queries = data
+    labels = (np.arange(db.shape[0]) % 5).astype(np.int32)
+    mesh = make_mesh(2, 4)
+    prog = ShardedKNN(db, mesh=mesh, k=9, labels=labels, num_classes=5)
+    ref = np.asarray(
+        knn_predict(jnp.asarray(db), jnp.asarray(labels), jnp.asarray(queries),
+                    k=9, num_classes=5)
+    )
+    got, stats = prog.predict_certified(queries)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_certified_rejects_non_l2(data):
+    db, queries = data
+    prog = ShardedKNN(db, mesh=make_mesh(8, 1), k=3, metric="l1")
+    with pytest.raises(ValueError, match="l2 metric only"):
+        prog.search_certified(queries)
+
+
+def test_pipeline_certified_mode(tmp_path, rng):
+    from knn_tpu.data.datasets import make_blobs, save_labeled_csv, save_unlabeled_csv
+
+    feats, labels = make_blobs(300, 6, 3, cluster_std=0.3, seed=9)
+    paths = {
+        "train": str(tmp_path / "train.csv"),
+        "val": str(tmp_path / "val.csv"),
+        "test": str(tmp_path / "test.csv"),
+    }
+    save_labeled_csv(paths["train"], feats[:200], labels[:200])
+    save_labeled_csv(paths["val"], feats[200:250], labels[200:250])
+    save_unlabeled_csv(paths["test"], feats[250:])
+
+    def cfg(mode):
+        return JobConfig(
+            train_file=paths["train"], test_file=paths["test"], val_file=paths["val"],
+            output_file=str(tmp_path / f"out_{mode}.csv"), k=5,
+            query_shards=4, db_shards=2, mode=mode,
+        )
+
+    exact = run_job(cfg("exact"))
+    cert = run_job(cfg("certified"))
+    np.testing.assert_array_equal(exact.test_labels, cert.test_labels)
+    np.testing.assert_array_equal(exact.val_labels, cert.val_labels)
+
+
+def test_config_rejects_certified_non_l2():
+    with pytest.raises(ValueError, match="requires the l2"):
+        JobConfig(mode="certified", metric="cosine")
+    with pytest.raises(ValueError, match="mode"):
+        JobConfig(mode="fast")
+    with pytest.raises(ValueError, match="selector"):
+        JobConfig(selector="magic")
