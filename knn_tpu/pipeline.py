@@ -43,6 +43,10 @@ class JobResult:
     n_test: int
     n_val: int
     config: JobConfig
+    #: ``--mode certified`` observability: how many queries certified exactly
+    #: on the fast path vs fell back to the widened re-select (None outside
+    #: certified mode).  Keys: "certified", "fallback_queries".
+    certified_stats: Optional[Dict[str, int]] = None
 
     @property
     def queries_per_sec(self) -> float:
@@ -52,7 +56,7 @@ class JobResult:
     def metrics(self) -> dict:
         """Structured per-run JSON — the metrics/observability subsystem the
         reference lacks (SURVEY.md §5: cout only, knn_mpi.cpp:348,398)."""
-        return {
+        out = {
             "val_accuracy": self.val_accuracy,
             "queries_per_sec": self.queries_per_sec,
             "total_time_s": self.total_time,
@@ -62,6 +66,9 @@ class JobResult:
             "n_val": self.n_val,
             "config": dataclasses.asdict(self.config),
         }
+        if self.certified_stats is not None:
+            out["certified_stats"] = self.certified_stats
+        return out
 
     def metrics_json(self) -> str:
         return json.dumps(self.metrics(), indent=2)
@@ -228,8 +235,9 @@ def run_job(cfg: JobConfig, *, mesh=None) -> JobResult:
         test_pred, val_pred = _run_native(
             cfg, timer, train, train_labels, test, val, val_labels_real
         )
+        certified_stats = None
     else:
-        test_pred, val_pred = _run_jax(
+        test_pred, val_pred, certified_stats = _run_jax(
             cfg, timer, train, train_labels, test, val, val_labels_real, mesh
         )
 
@@ -250,4 +258,5 @@ def run_job(cfg: JobConfig, *, mesh=None) -> JobResult:
         n_test=test.shape[0],
         n_val=0 if val is None else val.shape[0],
         config=cfg,
+        certified_stats=certified_stats,
     )

@@ -94,6 +94,15 @@ def test_pipeline_certified_mode(tmp_path, rng):
     np.testing.assert_array_equal(exact.test_labels, cert.test_labels)
     np.testing.assert_array_equal(exact.val_labels, cert.val_labels)
 
+    # --mode certified observability: stats land on the result and in metrics()
+    assert exact.certified_stats is None
+    assert "certified_stats" not in exact.metrics()
+    stats = cert.certified_stats
+    assert stats is not None
+    n_queries = cert.n_test + cert.n_val
+    assert stats["certified"] + stats["fallback_queries"] == n_queries
+    assert cert.metrics()["certified_stats"] == stats
+
 
 def test_config_rejects_certified_non_l2():
     with pytest.raises(ValueError, match="requires the l2"):
