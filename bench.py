@@ -1,30 +1,56 @@
 #!/usr/bin/env python
-"""Benchmark: brute-force exact KNN queries/sec on a SIFT1M-shaped workload
-(1M x 128 database, k=100 — BASELINE.json config 3), on whatever devices
-JAX exposes (the driver runs this on one real TPU chip).
+"""Benchmark: brute-force KNN queries/sec at SIFT1M shape (1M x 128, k=100 —
+BASELINE.json config 3) on whatever devices JAX exposes (the driver runs this
+on one real TPU chip).
 
 Prints EXACTLY ONE JSON line:
   {"metric": ..., "value": <q/s>, "unit": "queries/s", "vs_baseline": <x>, ...}
+On any failure (including backend init) it still prints one JSON line, with
+an "error" field, so the driver always gets a parseable record.
 
-``vs_baseline`` compares against the reference-style CPU brute force: the
-native C++ backend (knn_tpu/native, the reference program's semantics with
-std::thread standing in for its 8 MPI ranks) timed on a query subsample of
+Three measured configurations (the ``selectors`` table in the JSON):
+
+- ``exact``           coarse top-(K+margin) via lax.top_k + float64 host
+                      refinement — the selection-bound baseline path.
+- ``certified_approx``  the flagship: hardware ApproxTopK coarse pass +
+                      float64 refine + count-below certificate + exact
+                      fallback (ops.certified).  Exact by construction.
+- ``certified_pallas``  same pipeline with the fused Pallas distance+bin-min
+                      kernel (ops.pallas_knn) as the coarse pass.
+
+``value`` is the best configuration whose recall@K against the float64 CPU
+oracle is 1.0.  Protocol follows the reference report (PDF p.12 §4.2):
+each configuration is timed KNN_BENCH_RUNS (default 5) times after a
+warmup sweep; mean/std/min are reported.  MFU relates measured q/s to the
+matmul FLOPs actually executed (2*N*D per query per database pass) against
+the chip's peak — the "fast, not merely correct" check.
+
+``vs_baseline`` divides by the reference-style CPU brute force: the native
+C++ backend (knn_tpu/native, the reference program's semantics with
+std::thread standing in for its MPI ranks) timed on a query subsample of
 the SAME database.  The reference's own published numbers are MNIST-shaped
 and machine-specific (BASELINE.md); an in-situ CPU measurement is the
 honest denominator.
 
-Compute dtype is auto-selected: bfloat16 matmuls (MXU native) are used only
-if they keep recall@k = 1.0 against the float64 CPU oracle on the
-subsample; otherwise float32.
-
-Env overrides (testing): KNN_BENCH_N, KNN_BENCH_DIM, KNN_BENCH_K,
-KNN_BENCH_NQ, KNN_BENCH_BATCH, KNN_BENCH_TILE, KNN_BENCH_CPU_QUERIES,
-KNN_BENCH_DTYPE (skip auto: "float32" | "bfloat16").
+Env overrides:
+  KNN_BENCH_CONFIG   sift1m (default) | glove | gist1m   (BASELINE configs 3/4/5)
+  KNN_BENCH_MODES    comma list from {exact,certified_approx,certified_pallas}
+  KNN_BENCH_RUNS     timed repetitions per mode (default 5)
+  KNN_BENCH_N, KNN_BENCH_DIM, KNN_BENCH_K, KNN_BENCH_NQ, KNN_BENCH_BATCH,
+  KNN_BENCH_TILE, KNN_BENCH_CPU_QUERIES, KNN_BENCH_MARGIN,
+  KNN_BENCH_DTYPE    (bfloat16 | float32; default per config)
+  KNN_BENCH_PEAK_FLOPS    override the per-chip peak used for MFU
+  KNN_BENCH_PLATFORM      force a JAX platform (e.g. "cpu") before init
+  KNN_BENCH_INIT_TIMEOUT  seconds before backend init is declared hung (480)
+  KNN_BENCH_FALLBACK_CPU=1  run on CPU if accelerator init fails (the JSON
+                            records backend+device so the number stays honest)
 """
 
 import json
 import os
+import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -33,18 +59,133 @@ def _env_int(name, default):
     return int(os.environ.get(name, default))
 
 
-N = _env_int("KNN_BENCH_N", 1_000_000)
-DIM = _env_int("KNN_BENCH_DIM", 128)
-K = _env_int("KNN_BENCH_K", 100)
-NQ = _env_int("KNN_BENCH_NQ", 4096)
-BATCH = _env_int("KNN_BENCH_BATCH", 512)  # sweep winner on v5e (2026-07)
-TILE = _env_int("KNN_BENCH_TILE", 131_072)
-CPU_QUERIES = _env_int("KNN_BENCH_CPU_QUERIES", 32)
-DTYPE = os.environ.get("KNN_BENCH_DTYPE", "auto")
-#: Coarse pass fetches K + MARGIN candidates; exact float64 refinement on
-#: host re-selects the true top-K (ops.refine).  Margin absorbs float32
-#: near-boundary reorderings so recall@K lands at 1.0.
-MARGIN = _env_int("KNN_BENCH_MARGIN", 28)
+#: BASELINE.json configs 3/4/5.  ``certifiable`` = the count-below
+#: certificate applies (squared-L2 bound -> l2 only; cosine reports
+#: measured recall instead).
+CONFIGS = {
+    "sift1m": dict(n=1_000_000, dim=128, k=100, metric="l2", dtype="bfloat16"),
+    "glove": dict(n=1_183_514, dim=300, k=50, metric="cosine", dtype="bfloat16"),
+    "gist1m": dict(n=1_000_000, dim=960, k=100, metric="l2", dtype="bfloat16"),
+}
+
+try:
+    CONFIG = os.environ.get("KNN_BENCH_CONFIG", "sift1m")
+    _cfg = CONFIGS[CONFIG]
+    N = _env_int("KNN_BENCH_N", _cfg["n"])
+    DIM = _env_int("KNN_BENCH_DIM", _cfg["dim"])
+    K = _env_int("KNN_BENCH_K", _cfg["k"])
+    METRIC = os.environ.get("KNN_BENCH_METRIC", _cfg["metric"])
+    NQ = _env_int("KNN_BENCH_NQ", 4096)
+    BATCH = _env_int("KNN_BENCH_BATCH", 512)  # sweep winner on v5e (2026-07)
+    TILE = _env_int("KNN_BENCH_TILE", 131_072)
+    #: 64 queries ~ balances denominator noise against CPU runtime; the JSON
+    #: carries cpu_queries + per-query time so the claim is auditable.
+    CPU_QUERIES = _env_int("KNN_BENCH_CPU_QUERIES", 64)
+    DTYPE = os.environ.get("KNN_BENCH_DTYPE", _cfg["dtype"])
+    RUNS = _env_int("KNN_BENCH_RUNS", 5)
+    #: Coarse pass fetches K + MARGIN candidates; float64 refinement
+    #: re-selects the true top-K among them (ops.refine); the certificate
+    #: (ops.certified) then proves no true neighbor was missed, or falls back.
+    MARGIN = _env_int("KNN_BENCH_MARGIN", 28)
+except Exception as _e:  # bad env: the one-JSON-line contract still holds
+    print(json.dumps({
+        "metric": "knn_qps_config", "value": None, "unit": "queries/s",
+        "vs_baseline": None, "error": f"config: {_e!r}",
+    }))
+    sys.exit(1)
+
+#: bf16 MXU peak FLOP/s by device kind (public spec sheets); MFU is an
+#: *estimate* — the denominator assumes bf16 peak even for f32 runs.
+_PEAK_BY_KIND = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _emit(obj):
+    print(json.dumps(obj))
+    sys.stdout.flush()
+
+
+def _fail(stage, err, **extra):
+    _emit({
+        "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
+        "value": None,
+        "unit": "queries/s",
+        "vs_baseline": None,
+        "error": f"{stage}: {err}",
+        **extra,
+    })
+    sys.exit(1)
+
+
+def _init_backend():
+    """Import JAX and initialize the backend, surviving flaky accelerator
+    attach: bounded retries on raised init errors, a watchdog timeout on
+    hangs (the claim-relay can block in make_c_api_client indefinitely),
+    and an optional CPU fallback.  Returns the jax module."""
+    import threading
+
+    timeout = _env_int("KNN_BENCH_INIT_TIMEOUT", 480)
+    attempts = _env_int("KNN_BENCH_INIT_ATTEMPTS", 3)
+    state = {}
+
+    def work():
+        try:
+            import jax
+
+            plat = os.environ.get("KNN_BENCH_PLATFORM")
+            if plat:  # in-process force (env vars lose to sitecustomize plugins)
+                jax.config.update("jax_platforms", plat)
+            state["devices"] = jax.devices()
+            state["jax"] = jax
+        except Exception as e:  # noqa: BLE001 — recorded and retried
+            state["error"] = repr(e)
+
+    last_err = "unknown"
+    hung = False
+    for attempt in range(attempts):
+        state.pop("error", None)
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        t.join(timeout)  # per-attempt watchdog, as documented
+        if "devices" in state:
+            return state["jax"]
+        if t.is_alive():
+            # init is hung inside the runtime; a same-process retry (or a
+            # CPU fallback — it needs the same backend-init lock the hung
+            # thread holds) would block forever — bail with a parseable line
+            hung = True
+            last_err = f"backend init hung > {timeout}s (stale device claim?)"
+            break
+        last_err = state.get("error", "unknown")
+        if attempt + 1 >= attempts:
+            break  # no retry follows; don't delay the failure line
+        time.sleep(min(10.0 * (attempt + 1), 30.0))
+        try:  # drop the cached failed backend so the retry re-attaches
+            import jax
+
+            jax.clear_caches()
+            from jax._src import xla_bridge
+
+            xla_bridge.backends.cache_clear()
+        except Exception:  # pragma: no cover - cache API moved; retry anyway
+            pass
+    if os.environ.get("KNN_BENCH_FALLBACK_CPU") == "1" and not hung:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            jax.devices()
+            return jax
+        except Exception as e:  # noqa: BLE001
+            last_err = f"{last_err}; cpu fallback failed: {e!r}"
+    _fail("backend_init", last_err)
 
 
 def recall_at_k(pred_idx: np.ndarray, true_idx: np.ndarray) -> float:
@@ -54,7 +195,33 @@ def recall_at_k(pred_idx: np.ndarray, true_idx: np.ndarray) -> float:
     return hits / true_idx.size
 
 
+def _cpu_baseline(db, sub):
+    """Native C++ brute force (reference semantics) on the subsample:
+    (qps, mean per-query seconds, exact f64 top-K indices)."""
+    try:
+        from knn_tpu import native
+
+        if not native.available():
+            return None, None, None
+        t0 = time.perf_counter()
+        _, idx = native.knn_search(db, sub, K, METRIC, num_threads=8)
+        elapsed = time.perf_counter() - t0
+        return len(sub) / elapsed, elapsed / len(sub), idx
+    except Exception:
+        return None, None, None
+
+
 def main() -> None:
+    jax = _init_backend()
+    dev = jax.devices()[0]
+    backend = jax.default_backend()
+    # peak FLOPs for MFU: env override > known device kind > None (a v5e
+    # default on an unknown/CPU backend would yield a meaningless MFU)
+    if "KNN_BENCH_PEAK_FLOPS" in os.environ:
+        peak = float(os.environ["KNN_BENCH_PEAK_FLOPS"])
+    else:
+        peak = _PEAK_BY_KIND.get(getattr(dev, "device_kind", ""))
+
     from knn_tpu.ops.refine import refine_exact
     from knn_tpu.parallel.mesh import make_mesh
     from knn_tpu.parallel.sharded import ShardedKNN
@@ -62,90 +229,186 @@ def main() -> None:
     rng = np.random.default_rng(0)
     db = (rng.random(size=(N, DIM)) * 128.0).astype(np.float32)
     queries = (rng.random(size=(NQ, DIM)) * 128.0).astype(np.float32)
-
-    # --- CPU baseline (native C++ backend, all hardware threads) ----------
-    cpu_qps = None
-    oracle_idx = None
     sub = queries[:CPU_QUERIES]
-    try:
-        from knn_tpu import native
 
-        if native.available():
-            t0 = time.perf_counter()
-            _, oracle_idx = native.knn_search(db, sub, K, "l2", num_threads=8)
-            cpu_qps = CPU_QUERIES / (time.perf_counter() - t0)
-    except Exception:
-        pass
+    cpu_qps, cpu_per_q_s, oracle_idx = _cpu_baseline(db, sub)
 
-    # --- TPU path: coarse top-(K+MARGIN) on device, exact refine on host --
+    global DTYPE
+    if oracle_idx is None and "KNN_BENCH_DTYPE" not in os.environ:
+        # no oracle to verify bf16 recall against -> stay conservative for
+        # the exact (margin-heuristic) path; certified modes re-verify
+        # themselves either way
+        DTYPE = "float32"
+
     mesh = make_mesh()  # all devices; (1,1) on a single chip
     tile = min(TILE, N)
     coarse_k = min(K + MARGIN, N)
+    certifiable = METRIC in ("l2", "sql2", "euclidean")
 
+    modes = os.environ.get(
+        "KNN_BENCH_MODES",
+        "exact,certified_approx,certified_pallas" if certifiable else "exact",
+    ).split(",")
+
+    # ONE device placement of the (padded) database, shared by every mode:
+    # the exact path fetches k+margin via search(k=...), the certified
+    # paths use their own cached programs on the same placement.
     def build(dtype):
-        return ShardedKNN(db, mesh=mesh, k=coarse_k, metric="l2",
+        return ShardedKNN(db, mesh=mesh, k=K, metric=METRIC,
                           train_tile=tile, compute_dtype=dtype)
 
-    def run_sub(prog):
-        _, ci = prog.search(sub)
-        _, ri = refine_exact(db, sub, np.asarray(ci), K)
-        return ri
+    prog = build(DTYPE)
+    if DTYPE == "bfloat16" and oracle_idx is not None:
+        # recall-gate the dtype before committing to the full measurement:
+        # bf16 matmuls that misrank past the margin can't be repaired on
+        # the non-certified path, so demote to float32 (certified modes
+        # self-repair either way, but the headline must stay exact)
+        _, ci = prog.search(sub, k=coarse_k)
+        _, ri = refine_exact(db, sub, np.asarray(ci), K, METRIC)
+        if recall_at_k(ri, oracle_idx) < 1.0:
+            DTYPE = "float32"
+            del prog  # free the bf16 placement before the rebuild
+            prog = build(DTYPE)
 
-    # dtype choice: explicit env wins; "auto" promotes to bfloat16 only when
-    # the oracle confirms recall 1.0.  Exactly one program stays resident —
-    # each holds a full device placement of the database.
-    if DTYPE == "bfloat16":
-        chosen, prog = "bfloat16", build("bfloat16")
-    elif DTYPE == "auto" and oracle_idx is not None:
-        bf_prog = build("bfloat16")
-        if recall_at_k(run_sub(bf_prog), oracle_idx) == 1.0:
-            chosen, prog = "bfloat16", bf_prog  # reuse: compiled + placed
-        else:
-            chosen = "float32"
-            del bf_prog  # free its HBM placement before the real build
-            prog = build(None)
-    else:
-        chosen, prog = "float32", build(None)
-
-    recall = None
-    if oracle_idx is not None:
-        recall = recall_at_k(run_sub(prog), oracle_idx)
-
-    def batches():
-        for lo in range(0, NQ, BATCH):
-            chunk = queries[lo : lo + BATCH]
+    def batches(qs):
+        for lo in range(0, qs.shape[0], BATCH):
+            chunk = qs[lo : lo + BATCH]
             pad = BATCH - chunk.shape[0]
             yield lo, np.pad(chunk, ((0, pad), (0, 0))) if pad else chunk, pad
 
-    # warmup on the first padded chunk: the timed loop must hit a warm shape
-    _, warm_chunk, _ = next(batches())
-    prog.search(warm_chunk)[0].block_until_ready()
+    def sweep_exact(qs):
+        """Coarse device top-(K+margin), f64 host refine overlapped with the
+        next batches' device work.  Returns (idx [Q,K], stats=None)."""
+        coarse = [(lo, prog.search(chunk, k=coarse_k), pad)
+                  for lo, chunk, pad in batches(qs)]
+        out = []
+        for lo, (d, i), pad in coarse:
+            i = np.asarray(i)
+            if pad:
+                i = i[:-pad]
+            out.append(refine_exact(db, qs[lo : lo + i.shape[0]], i, K, METRIC)[1])
+        return np.concatenate(out), None
 
-    t0 = time.perf_counter()
-    coarse = [(lo, prog.search(chunk), pad) for lo, chunk, pad in batches()]
-    results = []
-    for lo, (d, i), pad in coarse:  # refine overlaps later batches' device work
-        i = np.asarray(i)
-        if pad:
-            i = i[:-pad]
-        results.append(refine_exact(db, queries[lo : lo + i.shape[0]], i, K))
-    elapsed = time.perf_counter() - t0
-    qps = NQ / elapsed
+    def sweep_certified(selector):
+        def run(qs):
+            idx_out, agg = [], {}
+            for lo, chunk, pad in batches(qs):
+                take = BATCH - pad
+                _, i, st = prog.search_certified(
+                    chunk[:take], margin=MARGIN, selector=selector
+                )
+                idx_out.append(i)
+                for key, v in st.items():  # incl. host_exact_queries
+                    agg[key] = agg.get(key, 0) + v
+            return np.concatenate(idx_out), agg
+        return run
 
-    result = {
-        "metric": f"exact_knn_qps_n{N}_d{DIM}_k{K}",
-        "value": round(qps, 2),
+    sweeps = {
+        "exact": sweep_exact,
+        "certified_approx": sweep_certified("approx"),
+        "certified_pallas": sweep_certified("pallas"),
+    }
+    #: database passes per query: coarse matmul, + the certificate's
+    #: count-below matmul for certified modes (fallback excluded — it is
+    #: rare, per-run stats record it)
+    passes = {"exact": 1, "certified_approx": 2, "certified_pallas": 2}
+
+    results = {}
+    for mode in modes:
+        entry = {}
+        try:
+            fn = sweeps[mode]
+            if oracle_idx is not None:
+                idx_sub, _ = fn(sub)  # also compiles every program involved
+                entry["recall_at_k"] = recall_at_k(idx_sub, oracle_idx)
+            fn(queries[:BATCH])  # warm the full-batch shape
+            times = []
+            stats = None
+            for _ in range(RUNS):
+                t0 = time.perf_counter()
+                _, stats = fn(queries)
+                times.append(time.perf_counter() - t0)
+            times = np.asarray(times)
+            qps = NQ / times
+            flops = 2.0 * NQ * N * DIM * passes[mode]
+            entry.update({
+                "qps_mean": round(float(qps.mean()), 2),
+                "qps_std": round(float(qps.std()), 2),
+                "qps_best": round(float(qps.max()), 2),
+                "time_mean_s": round(float(times.mean()), 4),
+                "runs": RUNS,
+                "mfu": (None if peak is None
+                        else round(flops / float(times.mean()) / peak, 4)),
+            })
+            if stats is not None:
+                entry["certified_stats"] = stats
+        except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+            entry["error"] = f"{type(e).__name__}: {e}"
+        results[mode] = entry
+
+    def _ok(m):
+        e = results.get(m, {})
+        if "qps_mean" not in e:
+            return False
+        r = e.get("recall_at_k")
+        if r is None:
+            # no oracle: certified modes are exact by construction, but the
+            # exact path's margin heuristic is unverified -> not headline
+            return m.startswith("certified")
+        return r == 1.0
+
+    ranked = sorted((m for m in results if _ok(m)),
+                    key=lambda m: -results[m]["qps_mean"])
+    recall_flag = {}
+    if not ranked:
+        # no mode with verified exactness; publish the fastest measured one
+        # honestly flagged rather than nothing.  Distinguish "no oracle to
+        # check against" from "checked and missed neighbors".
+        ranked = sorted((m for m in results if "qps_mean" in results[m]),
+                        key=lambda m: -results[m]["qps_mean"])
+        if ranked:
+            r = results[ranked[0]].get("recall_at_k")
+            recall_flag = (
+                {"recall_unverified": True} if r is None
+                else {"recall_below_one": True}
+            )
+    if not ranked:
+        _fail("all_modes", {m: results[m].get("error", "?") for m in results},
+              selectors=results, backend=backend)
+    best = ranked[0]
+    qps = results[best]["qps_mean"]
+
+    _emit({
+        "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
+        "value": qps,
         "unit": "queries/s",
         "vs_baseline": round(qps / cpu_qps, 2) if cpu_qps else None,
-        "recall_at_k": recall,
-        "compute_dtype": chosen,
+        "mode": best,
+        "recall_at_k": results[best].get("recall_at_k"),
+        **recall_flag,
+        "compute_dtype": DTYPE,
+        "metric_fn": METRIC,
+        "runs": RUNS,
+        "qps_std": results[best]["qps_std"],
+        "mfu": results[best]["mfu"],
+        "peak_flops_assumed": peak,
+        "selectors": results,
         "cpu_baseline_qps": round(cpu_qps, 2) if cpu_qps else None,
+        "cpu_queries": CPU_QUERIES,
+        "cpu_per_query_s": round(cpu_per_q_s, 4) if cpu_per_q_s else None,
         "devices": len(mesh.devices.ravel()),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "backend": backend,
         "batch": BATCH,
         "train_tile": tile,
-    }
-    print(json.dumps(result))
+    })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — the driver needs one JSON line, always
+        _fail("run", f"{type(e).__name__}: {e}",
+              tb=traceback.format_exc(limit=3).splitlines()[-3:])

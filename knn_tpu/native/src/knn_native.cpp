@@ -266,9 +266,17 @@ float* knn_native_read_csv(const char* path, int64_t* out_rows,
   *out_cols = 0;
   FILE* f = std::fopen(path, "rb");
   if (!f) return nullptr;
-  std::fseek(f, 0, SEEK_END);
+  // ftell returns -1 on non-seekable files; size_t(-1) would then be
+  // passed to fread against a 0-byte buffer
+  if (std::fseek(f, 0, SEEK_END) != 0) {
+    std::fclose(f);
+    return nullptr;
+  }
   const long size = std::ftell(f);
-  std::fseek(f, 0, SEEK_SET);
+  if (size < 0 || std::fseek(f, 0, SEEK_SET) != 0) {
+    std::fclose(f);
+    return nullptr;
+  }
   std::vector<char> buf(static_cast<size_t>(size) + 1);
   const size_t got = std::fread(buf.data(), 1, size, f);
   std::fclose(f);

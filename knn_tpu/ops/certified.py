@@ -123,6 +123,89 @@ def certification_tolerance(queries_np: np.ndarray, db_np: np.ndarray) -> np.nda
     return 8.0 * _F32_EPS * (q_norm + db_norm_max)
 
 
+def host_exact_knn(
+    db_np: np.ndarray, q_np: np.ndarray, k: int, *, tile: Optional[int] = None,
+    q_chunk: int = 8,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Unconditional last-resort exact KNN: tiled float64 direct-difference
+    full scan on host (no expanded-square cancellation, no approximation,
+    no certificate needed).  O(Q*N*D) host FLOPs — only for the handful of
+    queries that fail re-certification after the widened fallback."""
+    n = db_np.shape[0]
+    n_q = q_np.shape[0]
+    k = min(k, n)
+    if tile is None:
+        # bound the [q_chunk, tile, D] float64 broadcast temporaries at a
+        # fixed ~128 MB budget regardless of dimensionality
+        tile = max(128, (1 << 24) // (q_chunk * max(1, db_np.shape[1])))
+    bd = np.full((n_q, k), np.inf)
+    bi = np.full((n_q, k), np.iinfo(np.int64).max, dtype=np.int64)
+    for qlo in range(0, n_q, q_chunk):
+        qf = q_np[qlo : qlo + q_chunk].astype(np.float64)
+        cd, ci = bd[qlo : qlo + q_chunk], bi[qlo : qlo + q_chunk]
+        for lo in range(0, n, tile):
+            t = db_np[lo : lo + tile].astype(np.float64)
+            dt = ((qf[:, None, :] - t[None, :, :]) ** 2).sum(-1)
+            it = np.broadcast_to(
+                np.arange(lo, lo + t.shape[0], dtype=np.int64)[None, :], dt.shape
+            )
+            alld = np.concatenate([cd, dt], axis=-1)
+            alli = np.concatenate([ci, it], axis=-1)
+            srt = np.lexsort((alli, alld), axis=-1)[:, :k]
+            cd = np.take_along_axis(alld, srt, -1)
+            ci = np.take_along_axis(alli, srt, -1)
+        bd[qlo : qlo + q_chunk], bi[qlo : qlo + q_chunk] = cd, ci
+    return bd, bi
+
+
+def repair_uncertified(
+    d: np.ndarray,
+    i: np.ndarray,
+    k: int,
+    m: int,
+    bad: np.ndarray,
+    q_np: np.ndarray,
+    db_np: np.ndarray,
+    *,
+    select_fn,
+    count_fn,
+    max_widen: int,
+) -> int:
+    """Shared fallback repair for both certified pipelines (single-device
+    :func:`knn_search_certified` and the sharded
+    ``ShardedKNN.search_certified``) — ONE source of truth for the exactness
+    escalation:
+
+    1. widened exact-selector re-select (``widen = min(max(2m, m+64),
+       max_widen)``) + float64 refine;
+    2. re-certification of the repaired queries — a true neighbor pushed
+       past ``widen`` by f32 rounding must not be silently missed
+       (exactness may not rest on the margin heuristic);
+    3. unconditional float64 host scan (:func:`host_exact_knn`) for
+       persistent failures (heavy ties within the f32 tolerance, or a
+       genuinely missed neighbor).
+
+    ``select_fn(q_bad [B,D], widen) -> candidate indices [B, widen]``;
+    ``count_fn(q_bad [B,D], thresholds [B]) -> counts [B]``.
+    Mutates ``d``/``i`` in place at rows ``bad``; returns the number of
+    queries that needed the host-exact escalation.
+    """
+    if not bad.size:
+        return 0
+    widen = min(max(2 * m, m + 64), max_widen)
+    fi = select_fn(q_np[bad], widen)
+    fd2, fi2 = refine_exact(db_np, q_np[bad], np.asarray(fi), k)
+    d[bad], i[bad] = fd2, fi2
+    thr2 = fd2[:, k - 1] + certification_tolerance(q_np[bad], db_np)
+    counts2 = np.asarray(count_fn(q_np[bad], thr2))
+    still = np.flatnonzero(counts2 > k)
+    if still.size:
+        sb = bad[still]
+        d[sb], i[sb] = host_exact_knn(db_np, q_np[sb], k)
+        return int(sb.size)
+    return 0
+
+
 def knn_search_certified(
     queries,
     db,
@@ -169,12 +252,17 @@ def knn_search_certified(
     counts = np.asarray(count_below(db_j, q_j, jnp.asarray(thresholds), tile=tile))
 
     bad = np.flatnonzero(counts > k)
-    if bad.size:
-        # fetch k+margin here too: the tiled pass ranks in float32, so the
-        # refine step needs the same boundary slack as the coarse pass
-        _, fi = knn_search_tiled(
-            q_j[bad], db_j, m, "l2", train_tile=min(tile, n),
-        )
-        fd2, fi2 = refine_exact(db_np, queries_np[bad], np.asarray(fi), k)
-        d[bad], i[bad] = fd2, fi2
-    return d, i, {"fallback_queries": int(bad.size), "certified": n_q - int(bad.size)}
+    host_exact = repair_uncertified(
+        d, i, k, m, bad, queries_np, db_np,
+        select_fn=lambda qb, widen: knn_search_tiled(
+            jnp.asarray(qb), db_j, widen, "l2", train_tile=min(tile, n)
+        )[1],
+        count_fn=lambda qb, thr: count_below(
+            db_j, jnp.asarray(qb), jnp.asarray(thr), tile=tile
+        ),
+        max_widen=n,
+    )
+    stats = {"fallback_queries": int(bad.size), "certified": n_q - int(bad.size)}
+    if host_exact:
+        stats["host_exact_queries"] = host_exact
+    return d, i, stats

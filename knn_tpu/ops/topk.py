@@ -161,16 +161,16 @@ def knn_search_approx(
     (SURVEY.md §7 step 6).  L2 only: uses the -||t||^2 + 2 q.t^T MIPS score
     so approx_max_k's aggregate-to-topk path applies.  ``n_valid`` (may be
     traced) masks trailing padding rows out of the candidate set."""
+    from knn_tpu.ops.distance import _dot
+
     t32 = train.astype(jnp.float32)
     half_t_norm = 0.5 * jnp.sum(t32 * t32, axis=-1)[None, :]
     if compute_dtype is None:
         compute_dtype = queries.dtype
-    qt = lax.dot_general(
-        queries.astype(compute_dtype),
-        train.astype(compute_dtype),
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    # _dot requests HIGHEST precision for f32 inputs — without it the TPU
+    # decomposes the f32 matmul into bf16 passes, silently costing distance
+    # bits and raising the certified-path fallback rate.
+    qt = _dot(queries, train, compute_dtype)
     score = qt - half_t_norm  # argmax_t score == argmin_t ||q-t||^2
     if n_valid is not None:
         cols = lax.broadcasted_iota(jnp.int32, (1, train.shape[0]), 1)

@@ -23,6 +23,7 @@ from knn_tpu.parallel.mesh import (
 from knn_tpu.parallel.collectives import (
     replicate,
     shard,
+    gather,
     allreduce_min,
     allreduce_max,
     barrier,
@@ -43,6 +44,7 @@ __all__ = [
     "DB_AXIS",
     "replicate",
     "shard",
+    "gather",
     "allreduce_min",
     "allreduce_max",
     "barrier",

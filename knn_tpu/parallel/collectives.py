@@ -41,6 +41,22 @@ def shard(x, mesh: Mesh, axis_name: str, axis: int = 0) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P(*spec)))
 
 
+def gather(
+    x: jax.Array,
+    axis_name: Union[str, Sequence[str]],
+    *,
+    axis: int = 0,
+    tiled: bool = True,
+) -> jax.Array:
+    """MPI_Gather (knn_mpi.cpp:340,383): assemble the per-device shards along
+    ``axis``.  Every device receives the full array (i.e. MPI_Allgather —
+    a root-only gather has no cheaper TPU analogue; the reference's root
+    rank is just "whoever writes the file").  ``tiled=True`` concatenates
+    shards; ``tiled=False`` stacks a new leading device axis.  Call inside
+    shard_map."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
 def allreduce_min(x: jax.Array, axis_name: Union[str, Sequence[str]]) -> jax.Array:
     """MPI_Allreduce(MPI_MIN) (knn_mpi.cpp:277).  Call inside shard_map."""
     return lax.pmin(x, axis_name)

@@ -36,11 +36,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from knn_tpu.ops.normalize import local_minmax, minmax_apply
 from knn_tpu.ops.topk import knn_search_tiled, merge_topk, topk_pairs
 from knn_tpu.ops.vote import majority_vote
+from knn_tpu.parallel.collectives import (
+    allreduce_max,
+    allreduce_min,
+    gather,
+    replicate,
+    shard,
+)
 from knn_tpu.parallel.mesh import DB_AXIS, QUERY_AXIS, pad_to_multiple
 
 _INT_SENTINEL = jnp.iinfo(jnp.int32).max
@@ -66,8 +73,8 @@ def _ring_merge(d, i, k: int, axis_name: str, n_shards: int):
 
 
 def _allgather_merge(d, i, k: int, axis_name: str):
-    ad = lax.all_gather(d, axis_name, axis=0)  # [P, Qs, k]
-    ai = lax.all_gather(i, axis_name, axis=0)
+    ad = gather(d, axis_name, axis=0, tiled=False)  # [P, Qs, k]
+    ai = gather(i, axis_name, axis=0, tiled=False)
     qs = d.shape[0]
     ad = jnp.moveaxis(ad, 0, 1).reshape(qs, -1)
     ai = jnp.moveaxis(ai, 0, 1).reshape(qs, -1)
@@ -208,7 +215,7 @@ class ShardedKNN:
         self._dtype_key = (
             None if compute_dtype is None else jnp.dtype(compute_dtype).name
         )
-        self._tp = jax.device_put(tp, NamedSharding(mesh, P(DB_AXIS)))
+        self._tp = shard(tp, mesh, DB_AXIS)  # the reference's Scatter, once
         self._labels = None
         self.num_classes = num_classes
         if labels is not None:
@@ -219,19 +226,30 @@ class ShardedKNN:
                 raise ValueError(
                     f"labels shape {labels.shape} != (n_train,) = ({n_train},)"
                 )
-            self._labels = jax.device_put(labels, NamedSharding(mesh, P()))
+            self._labels = replicate(labels, mesh)  # the reference's Bcast
 
     def _place_queries(self, queries):
         if not isinstance(queries, jax.Array):
             queries = np.asarray(queries)
         qp, n_q = pad_to_multiple(queries, self.mesh.shape[QUERY_AXIS])
-        return jax.device_put(qp, NamedSharding(self.mesh, P(QUERY_AXIS))), n_q
+        return shard(qp, self.mesh, QUERY_AXIS), n_q
 
-    def search(self, queries: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        """(distances, global indices) [Q, k] of the k nearest database rows."""
+    def search(
+        self, queries: jax.Array, *, k: Optional[int] = None
+    ) -> Tuple[jax.Array, jax.Array]:
+        """(distances, global indices) [Q, k] of the k nearest database rows.
+
+        ``k`` overrides the constructor's k for this call (e.g. fetching
+        k+margin candidates for host refinement) while reusing the same
+        device placement; each distinct k compiles its own cached program.
+        """
+        k = self.k if k is None else k
+        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        if k > min(self.n_train, shard_rows):
+            raise ValueError(f"k={k} exceeds shard rows {shard_rows}")
         qp, n_q = self._place_queries(queries)
         fn = _knn_program(
-            self.mesh, self.k, self.metric, self.merge, self.n_train,
+            self.mesh, k, self.metric, self.merge, self.n_train,
             self.train_tile, self._dtype_key,
         )
         d, i = fn(qp, self._tp)
@@ -258,7 +276,10 @@ class ShardedKNN:
             raise ValueError("search_certified supports the l2 metric only")
         if selector not in SELECTORS:
             raise ValueError(f"unknown selector {selector!r}; expected {SELECTORS}")
-        from knn_tpu.ops.certified import certification_tolerance
+        from knn_tpu.ops.certified import (
+            certification_tolerance,
+            repair_uncertified,
+        )
         from knn_tpu.ops.refine import refine_exact
 
         q_np = np.asarray(queries, dtype=np.float32)
@@ -285,26 +306,42 @@ class ShardedKNN:
         thresholds = d[:, self.k - 1] + certification_tolerance(q_np, db_np)
         thr_p = np.full(qp.shape[0], -np.inf, dtype=np.float32)
         thr_p[:n_q] = thresholds
-        thr_p = jax.device_put(thr_p, NamedSharding(self.mesh, P(QUERY_AXIS)))
+        thr_p = shard(thr_p, self.mesh, QUERY_AXIS)
         count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
         counts = np.asarray(count_fn(qp, self._tp, thr_p))[:n_q]
 
         bad = np.flatnonzero(counts > self.k)
-        if bad.size:
+
+        def _select(qb, widen):
+            # widened exact-selector re-select (bounded by the per-shard
+            # rows the SPMD select can fetch)
             exact = _knn_program(
-                self.mesh, m, self.metric, self.merge, self.n_train,
+                self.mesh, widen, self.metric, self.merge, self.n_train,
                 self.train_tile, self._dtype_key, "exact",
             )
-            bq, _ = self._place_queries(q_np[bad])
-            _, fi = exact(bq, self._tp)
-            fd2, fi2 = refine_exact(
-                db_np, q_np[bad], np.asarray(fi)[: bad.size], self.k
-            )
-            d[bad], i[bad] = fd2, fi2
-        return d, i, {
+            bq, _ = self._place_queries(qb)
+            return np.asarray(exact(bq, self._tp)[1])[: qb.shape[0]]
+
+        def _count(qb, thr):
+            bq, _ = self._place_queries(qb)
+            thr_p = np.full(bq.shape[0], -np.inf, dtype=np.float32)
+            thr_p[: qb.shape[0]] = thr
+            return np.asarray(
+                count_fn(bq, self._tp, shard(thr_p, self.mesh, QUERY_AXIS))
+            )[: qb.shape[0]]
+
+        host_exact = repair_uncertified(
+            d, i, self.k, m, bad, q_np, db_np,
+            select_fn=_select, count_fn=_count,
+            max_widen=min(self.n_train, shard_rows),
+        )
+        stats = {
             "fallback_queries": int(bad.size),
             "certified": n_q - int(bad.size),
         }
+        if host_exact:
+            stats["host_exact_queries"] = host_exact
+        return d, i, stats
 
     def predict_certified(
         self, queries, *, margin: int = 28, selector: str = "approx"
@@ -459,8 +496,8 @@ def _minmax_program(mesh: Mesh, n_arrays: int):
             lo = alo if lo is None else jnp.minimum(lo, alo)
             hi = ahi if hi is None else jnp.maximum(hi, ahi)
         # The reference's two Allreduces, knn_mpi.cpp:276-277:
-        lo = lax.pmin(lax.pmin(lo, QUERY_AXIS), DB_AXIS)
-        hi = lax.pmax(lax.pmax(hi, QUERY_AXIS), DB_AXIS)
+        lo = allreduce_min(lo, (QUERY_AXIS, DB_AXIS))
+        hi = allreduce_max(hi, (QUERY_AXIS, DB_AXIS))
         return lo, hi
 
     return jax.jit(
@@ -500,7 +537,7 @@ def sharded_minmax(
         if target != n:
             pad_fn = np.pad if isinstance(a, np.ndarray) else jnp.pad
             a = pad_fn(a, ((0, target - n), (0, 0)), mode="edge")
-        padded.append(jax.device_put(a, NamedSharding(mesh, P((QUERY_AXIS, DB_AXIS)))))
+        padded.append(shard(a, mesh, (QUERY_AXIS, DB_AXIS)))
     fn = _minmax_program(mesh, len(padded))
     return fn(*padded)
 

@@ -153,8 +153,8 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
                 labels_out, stats = program.predict_certified(
                     chunk[:take], selector=cfg.selector
                 )
-                certified_stats["fallback_queries"] += stats["fallback_queries"]
-                certified_stats["certified"] += stats["certified"]
+                for key, v in stats.items():  # incl. host_exact_queries
+                    certified_stats[key] = certified_stats.get(key, 0) + v
                 out.append(np.asarray(labels_out))
             else:
                 out.append(np.asarray(program.predict(chunk))[:take])

@@ -89,3 +89,29 @@ def test_certified_k_too_large(data):
     db, queries = data
     with pytest.raises(ValueError, match="k="):
         knn_search_certified(queries, db, db.shape[0] + 1)
+
+
+def test_host_exact_knn_matches_oracle(data):
+    from knn_tpu.ops.certified import host_exact_knn
+
+    db, queries = data
+    ref_d, ref_i = _oracle(db, queries, 9)
+    d, i = host_exact_knn(db, queries, 9, tile=128, q_chunk=7)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=0, atol=0)
+
+
+def test_persistent_certificate_failure_goes_host_exact(rng):
+    # more identical nearest rows than k: count_below always exceeds k, so
+    # the widened fallback re-certification keeps failing and the pipeline
+    # must drop to the unconditional float64 host scan — still exact, with
+    # ties resolved to the lowest indices
+    db = rng.normal(size=(400, 8)).astype(np.float32) * 20
+    q = rng.normal(size=(6, 8)).astype(np.float32)
+    db[50:70] = q[0] + 0.001  # 20 near-identical rows beside query 0
+    ref_d, ref_i = _oracle(db, q, 3)
+    d, i, stats = knn_search_certified(q, db, 3, tile=128, margin=2)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-12)
+    assert stats["fallback_queries"] >= 1
+    assert stats.get("host_exact_queries", 0) >= 1
