@@ -291,16 +291,12 @@ def main() -> None:
 
     def sweep_certified(selector):
         def run(qs):
-            idx_out, agg = [], {}
-            for lo, chunk, pad in batches(qs):
-                take = BATCH - pad
-                _, i, st = prog.search_certified(
-                    chunk[:take], margin=MARGIN, selector=selector
-                )
-                idx_out.append(i)
-                for key, v in st.items():  # incl. host_exact_queries
-                    agg[key] = agg.get(key, 0) + v
-            return np.concatenate(idx_out), agg
+            # one pipelined call: all coarse selects dispatch up front, host
+            # refine overlaps later batches' device work (sharded.py)
+            _, i, st = prog.search_certified(
+                qs, margin=MARGIN, selector=selector, batch_size=BATCH
+            )
+            return i, st
         return run
 
     sweeps = {

@@ -115,11 +115,18 @@ def _approx_candidates(
 _F32_EPS = float(np.finfo(np.float32).eps)
 
 
-def certification_tolerance(queries_np: np.ndarray, db_np: np.ndarray) -> np.ndarray:
+def certification_tolerance(
+    queries_np: np.ndarray, db_np: np.ndarray,
+    *, db_norm_max: Optional[float] = None,
+) -> np.ndarray:
     """Per-query additive slack [Q] covering the float32 distance error in
-    the certificate's count pass (see module docstring, step 3)."""
+    the certificate's count pass (see module docstring, step 3).
+
+    ``db_norm_max`` lets batched callers hoist the full-database norm
+    reduction (a float64 pass over all N rows) out of their batch loop."""
     q_norm = (queries_np.astype(np.float64) ** 2).sum(-1)
-    db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
+    if db_norm_max is None:
+        db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
     return 8.0 * _F32_EPS * (q_norm + db_norm_max)
 
 

@@ -265,13 +265,22 @@ class ShardedKNN:
         return self._train_host
 
     def search_certified(
-        self, queries, *, margin: int = 28, selector: str = "approx"
+        self, queries, *, margin: int = 28, selector: str = "approx",
+        batch_size: Optional[int] = None,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded:
         coarse top-(k+margin) with a fast selector, float64 host refine,
         distributed count-below certificate (psum over the db axis), exact
         fallback for flagged queries.  Returns (dists_f64, idx, stats).
-        L2 only (the certificate threshold is a squared-L2 bound)."""
+        L2 only (the certificate threshold is a squared-L2 bound).
+
+        ``batch_size`` streams the queries in fixed-size batches with the
+        device stages pipelined against the host stages: every batch's
+        coarse select is dispatched up front (one compiled shape), so the
+        host refine of batch b overlaps the device work of batches > b,
+        and each batch's certificate count dispatches as soon as its
+        thresholds exist.  None = one batch (all queries at once).
+        """
         if self.metric not in ("l2", "sql2", "euclidean"):
             raise ValueError("search_certified supports the l2 metric only")
         if selector not in SELECTORS:
@@ -294,21 +303,56 @@ class ShardedKNN:
             from knn_tpu.ops.pallas_knn import BIN_W
 
             m = min(m, max(self.k, shard_rows // BIN_W))
-        qp, _ = self._place_queries(q_np)
         coarse = _knn_program(
             self.mesh, m, self.metric, self.merge, self.n_train,
             self.train_tile, self._dtype_key, selector,
         )
-        _, ci = coarse(qp, self._tp)
-        db_np = self._host_train()
-        d, i = refine_exact(db_np, q_np, np.asarray(ci)[:n_q], self.k)
-
-        thresholds = d[:, self.k - 1] + certification_tolerance(q_np, db_np)
-        thr_p = np.full(qp.shape[0], -np.inf, dtype=np.float32)
-        thr_p[:n_q] = thresholds
-        thr_p = shard(thr_p, self.mesh, QUERY_AXIS)
         count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
-        counts = np.asarray(count_fn(qp, self._tp, thr_p))[:n_q]
+        db_np = self._host_train()
+
+        if batch_size is not None and batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        bs = n_q if batch_size is None else batch_size
+        # hoisted: the db-side term of the certificate tolerance is
+        # query-independent (a float64 pass over all N rows)
+        db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
+        batches = []
+        for lo in range(0, n_q, bs):
+            chunk = q_np[lo : lo + bs]
+            pad = bs - chunk.shape[0]
+            if pad:  # one compiled shape for the tail too
+                chunk = np.pad(chunk, ((0, pad), (0, 0)))
+            batches.append((lo, chunk, pad))
+
+        # stage 1: dispatch every batch's coarse select (async on device)
+        coarse_out = []
+        for lo, chunk, pad in batches:
+            qp, _ = self._place_queries(chunk)
+            coarse_out.append((qp, coarse(qp, self._tp)))
+
+        # stage 2: per batch — sync its candidates, float64 host refine
+        # (overlapping later batches' device work), dispatch its count
+        d = np.empty((n_q, self.k))
+        i = np.empty((n_q, self.k), dtype=np.int64)
+        count_out = []
+        for (lo, chunk, pad), (qp, (_, ci)) in zip(batches, coarse_out):
+            take = bs - pad
+            ci = np.asarray(ci)[:take]
+            d_b, i_b = refine_exact(db_np, q_np[lo : lo + take], ci, self.k)
+            d[lo : lo + take], i[lo : lo + take] = d_b, i_b
+            thr = d_b[:, self.k - 1] + certification_tolerance(
+                q_np[lo : lo + take], db_np, db_norm_max=db_norm_max
+            )
+            thr_p = np.full(qp.shape[0], -np.inf, dtype=np.float32)
+            thr_p[:take] = thr
+            count_out.append(
+                (lo, take, count_fn(qp, self._tp, shard(thr_p, self.mesh, QUERY_AXIS)))
+            )
+
+        # stage 3: collect certificates, repair all flagged queries at once
+        counts = np.empty(n_q, dtype=np.int64)
+        for lo, take, c in count_out:
+            counts[lo : lo + take] = np.asarray(c)[:take]
 
         bad = np.flatnonzero(counts > self.k)
 
@@ -344,7 +388,8 @@ class ShardedKNN:
         return d, i, stats
 
     def predict_certified(
-        self, queries, *, margin: int = 28, selector: str = "approx"
+        self, queries, *, margin: int = 28, selector: str = "approx",
+        batch_size: Optional[int] = None,
     ):
         """Certified-exact classification: exact neighbor sets from
         :meth:`search_certified`, then the reference vote (ops.vote).
@@ -352,7 +397,7 @@ class ShardedKNN:
         if self._labels is None:
             raise RuntimeError("ShardedKNN built without labels; predict unavailable")
         _, idx, stats = self.search_certified(
-            queries, margin=margin, selector=selector
+            queries, margin=margin, selector=selector, batch_size=batch_size
         )
         labels_host = np.asarray(self._labels)
         votes = majority_vote(jnp.asarray(labels_host[idx]), self.num_classes)

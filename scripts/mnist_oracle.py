@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""The reference's accuracy oracle at real scale (SURVEY.md §4 item 1):
+60000x784 train / 10000 test / 10000 val, K=50, L2, min-max normalized —
+the compiled-in defaults of knn_mpi.cpp:108-119, whose published result is
+4.61% test error (report PDF p.12 §4.2.1).
+
+Real MNIST is not fetchable in this environment (zero egress), so the run
+uses data.datasets.make_mnist_like — an MNIST-shaped surrogate calibrated
+to the same KNN accuracy band (~95%).  What this oracle then proves:
+
+  1. both backends survive the reference's full scale;
+  2. the native C++ backend (reference semantics) and the sharded JAX
+     backend produce IDENTICAL labels on all 20k queries (bitwise parity,
+     including vote tie-breaks);
+  3. accuracy lands in the reference's band on both.
+
+Writes MNIST_ORACLE.json at the repo root and prints a summary.
+
+Usage: python scripts/mnist_oracle.py [--quick]   (--quick = 1/10 scale)
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+
+from knn_tpu.data.datasets import (  # noqa: E402
+    make_mnist_like,
+    save_labeled_csv,
+    save_unlabeled_csv,
+)
+from knn_tpu.pipeline import run_job  # noqa: E402
+from knn_tpu.utils.config import JobConfig  # noqa: E402
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    scale = 10 if quick else 1
+    n_train, n_test, n_val = 60_000 // scale, 10_000 // scale, 10_000 // scale
+
+    t0 = time.time()
+    print(f"generating surrogate ({n_train}/{n_test}/{n_val} x 784)...", flush=True)
+    train, trl, test, tel, val, vall = make_mnist_like(n_train, n_test, n_val)
+
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="mnist_oracle_")
+    save_labeled_csv(f"{d}/train.csv", train, trl)
+    save_labeled_csv(f"{d}/val.csv", val, vall)
+    save_unlabeled_csv(f"{d}/test.csv", test)
+    print(f"CSVs written to {d} in {time.time() - t0:.0f}s", flush=True)
+
+    def cfg(backend):
+        return JobConfig(
+            train_file=f"{d}/train.csv",
+            test_file=f"{d}/test.csv",
+            val_file=f"{d}/val.csv",
+            output_file=f"{d}/Test_label_{backend}.csv",
+            k=50, metric="l2", normalize=True, backend=backend,
+            num_classes=10,
+            # jax path: 8-device CPU mesh, both axes sharded, HBM-tiled
+            query_shards=4, db_shards=2, train_tile=8192, batch_size=2048,
+        )
+
+    results = {}
+    for backend in ("native", "jax"):
+        print(f"running backend={backend} ...", flush=True)
+        t0 = time.time()
+        res = run_job(cfg(backend))
+        test_acc = float(np.mean(res.test_labels == tel))
+        results[backend] = {
+            "val_accuracy": res.val_accuracy,
+            "test_accuracy": test_acc,
+            "test_error_pct": round(100 * (1 - test_acc), 2),
+            "total_time_s": round(res.total_time, 2),
+            "phase_times_s": {k: round(v, 2) for k, v in res.phase_times.items()},
+            "labels": res.test_labels,
+            "val_labels": res.val_labels,
+        }
+        print(f"  {backend}: val_acc={res.val_accuracy:.4f} "
+              f"test_acc={test_acc:.4f} in {time.time() - t0:.0f}s", flush=True)
+
+    test_parity = bool((results["native"]["labels"] == results["jax"]["labels"]).all())
+    val_parity = bool(
+        (results["native"]["val_labels"] == results["jax"]["val_labels"]).all()
+    )
+    for r in results.values():
+        del r["labels"], r["val_labels"]
+
+    artifact = {
+        "workload": {
+            "n_train": n_train, "n_test": n_test, "n_val": n_val, "dim": 784,
+            "k": 50, "metric": "l2", "normalize": True,
+            "data": "make_mnist_like surrogate (real MNIST unfetchable: zero egress)",
+            "reference": "knn_mpi.cpp:108-119 defaults; PDF p.12 4.61% error",
+        },
+        "backends": results,
+        "label_parity": {"test": test_parity, "val": val_parity},
+        "quick": quick,
+    }
+    out = os.path.join(REPO, "MNIST_ORACLE.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(artifact, indent=2))
+    assert test_parity and val_parity, "backend parity FAILED"
+    band = (0.93, 0.995)
+    for b, r in results.items():
+        assert band[0] <= r["val_accuracy"] <= band[1], (b, r["val_accuracy"])
+    print(f"oracle OK -> {out}")
+
+
+if __name__ == "__main__":
+    main()

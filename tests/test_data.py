@@ -119,3 +119,28 @@ def test_make_blobs_separable():
     cents = np.stack([feats[labels == c].mean(0) for c in range(3)])
     d01 = np.linalg.norm(cents[0] - cents[1])
     assert d01 > 1.0
+
+
+def test_make_mnist_like_shapes_and_accuracy_band():
+    # small-scale draw from the MNIST-shaped surrogate: pixel range, label
+    # range, and a KNN accuracy inside the band the generator is calibrated
+    # for (the reference's oracle reports 95.39%, PDF p.12)
+    from knn_tpu.data.datasets import make_mnist_like
+
+    train, trl, test, tel, val, vall = make_mnist_like(4000, 500, 500, seed=3)
+    assert train.shape == (4000, 784) and test.shape == (500, 784)
+    for arr in (train, test, val):
+        assert arr.dtype == np.float32
+        assert arr.min() >= 0.0 and arr.max() <= 255.0
+    for lab in (trl, tel, vall):
+        assert lab.dtype == np.int32
+        assert lab.min() >= 0 and lab.max() <= 9
+    # normalized K=50 L2 KNN accuracy (numpy, no jax needed)
+    lo, hi = train.min(0), train.max(0)
+    rng_ = np.where(hi - lo != 0, hi - lo, 1)
+    trn, ten = (train - lo) / rng_, (test - lo) / rng_
+    d = (ten**2).sum(1)[:, None] + (trn**2).sum(1)[None, :] - 2 * ten @ trn.T
+    idx = np.argpartition(d, 50, axis=1)[:, :50]
+    pred = np.array([np.bincount(trl[i], minlength=10).argmax() for i in idx])
+    acc = (pred == tel).mean()
+    assert 0.88 <= acc <= 0.995, acc

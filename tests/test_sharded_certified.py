@@ -111,3 +111,17 @@ def test_config_rejects_certified_non_l2():
         JobConfig(mode="fast")
     with pytest.raises(ValueError, match="selector"):
         JobConfig(selector="magic")
+
+
+@pytest.mark.parametrize("batch_size", [16, 37, 64])
+def test_sharded_certified_batched_matches_unbatched(data, batch_size):
+    # pipelined batching is an execution strategy, not a semantic knob:
+    # results must be identical for any batch size, including non-dividing
+    # and larger-than-Q sizes
+    db, queries = data
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=7)
+    ref_d, ref_i, _ = prog.search_certified(queries)
+    d, i, stats = prog.search_certified(queries, batch_size=batch_size)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_array_equal(d, ref_d)
+    assert stats["certified"] + stats["fallback_queries"] == queries.shape[0]
