@@ -1,0 +1,69 @@
+"""bench.py contract tests — round 1 died because the bench crashed in
+backend init and emitted nothing parseable.  These pin the contract: one
+JSON line on stdout, success or failure, with the documented fields."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_extra, timeout=300):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=REPO,
+    )
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    return proc.returncode, lines
+
+
+@pytest.mark.slow
+def test_bench_emits_one_parseable_success_line():
+    rc, lines = _run({
+        "KNN_BENCH_PLATFORM": "cpu",
+        "KNN_BENCH_N": "4000", "KNN_BENCH_NQ": "64", "KNN_BENCH_BATCH": "32",
+        "KNN_BENCH_K": "5", "KNN_BENCH_MARGIN": "4", "KNN_BENCH_TILE": "2048",
+        "KNN_BENCH_CPU_QUERIES": "8", "KNN_BENCH_RUNS": "1",
+        "KNN_BENCH_MODES": "certified_approx",
+    })
+    assert rc == 0, lines
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for field in ("metric", "value", "unit", "vs_baseline", "runs",
+                  "selectors", "mode", "backend"):
+        assert field in rec, field
+    assert rec["value"] > 0
+    assert rec["unit"] == "queries/s"
+    sel = rec["selectors"]["certified_approx"]
+    assert sel["certified_stats"]["certified"] + \
+        sel["certified_stats"]["fallback_queries"] == 64
+
+
+def test_bench_bad_config_still_emits_json_line():
+    rc, lines = _run({"KNN_BENCH_CONFIG": "not_a_config"}, timeout=60)
+    assert rc == 1
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["value"] is None
+    assert "error" in rec
+
+
+def test_bench_bad_platform_still_emits_json_line():
+    rc, lines = _run({
+        "KNN_BENCH_PLATFORM": "bogus",
+        "KNN_BENCH_INIT_ATTEMPTS": "1",
+        "KNN_BENCH_INIT_TIMEOUT": "30",
+    }, timeout=120)
+    assert rc == 1
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["value"] is None
+    assert "backend_init" in rec["error"]
