@@ -233,6 +233,23 @@ def main() -> None:
 
     cpu_qps, cpu_per_q_s, oracle_idx = _cpu_baseline(db, sub)
 
+    global METRIC
+    metric_label = METRIC
+    if METRIC == "cosine":
+        # cosine distance on row-normalized vectors IS squared-L2 ranking
+        # (||q̂-t̂||² = 2(1-q̂·t̂)), so normalizing once up front lets the
+        # glove config run the whole certified-exact machinery; the CPU
+        # oracle above ranked true cosine on the raw data, so the recall
+        # check still validates the equivalence end-to-end
+        def _rownorm(x):
+            n64 = np.linalg.norm(x.astype(np.float64), axis=-1, keepdims=True)
+            return (x / np.maximum(n64, 1e-24)).astype(np.float32)
+
+        db, queries = _rownorm(db), _rownorm(queries)
+        sub = queries[:CPU_QUERIES]
+        METRIC = "l2"
+        metric_label = "cosine (as normalized l2)"
+
     global DTYPE
     if oracle_idx is None and "KNN_BENCH_DTYPE" not in os.environ:
         # no oracle to verify bf16 recall against -> stay conservative for
@@ -383,7 +400,7 @@ def main() -> None:
         "recall_at_k": results[best].get("recall_at_k"),
         **recall_flag,
         "compute_dtype": DTYPE,
-        "metric_fn": METRIC,
+        "metric_fn": metric_label,
         "runs": RUNS,
         "qps_std": results[best]["qps_std"],
         "mfu": results[best]["mfu"],

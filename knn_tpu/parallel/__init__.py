@@ -11,6 +11,10 @@ Mapping (rank ↔ mesh device):
   MPI_Comm_rank  -> lax.axis_index                      (inside shard_map)
   MPI_Comm_size  -> mesh.shape[axis]
   MPI_Abort      -> pad-to-multiple instead             (mesh.pad_to_multiple)
+
+Multi-host (``mpiexec`` across nodes -> one JAX process per host over DCN)
+lives in :mod:`knn_tpu.parallel.multihost`: initialize / global_mesh /
+shard_across_hosts / process_row_slice.
 """
 
 from knn_tpu.parallel.mesh import (

@@ -1,0 +1,126 @@
+"""Multi-host execution — the DCN half of the communication backend.
+
+The reference scales with ``mpiexec -n N`` across nodes: every rank is an
+OS process and MPI wires them together (knn_mpi.cpp:123-125; report PDF
+p.5-7 §2.2).  The TPU-native equivalent is one JAX process per host joined
+through :func:`jax.distributed.initialize`; after that, ``jax.devices()``
+is the *global* device list, the 2-D mesh (parallel.mesh) spans every
+host, and the SAME SPMD programs (parallel.sharded) run unchanged — XLA
+routes collectives over ICI within a slice and DCN across slices.  There
+is no second code path: multi-host is a bigger mesh.
+
+What this module adds is the data-movement story MPI gets from its
+collectives: each host holds only its own slice of the database/queries
+(the reference instead makes rank 0 read everything and Bcast it —
+knn_mpi.cpp:154-175,224), and :func:`shard_across_hosts` assembles those
+host-local rows into one globally-sharded ``jax.Array`` without any host
+ever materializing the full matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from knn_tpu.parallel.mesh import DB_AXIS, QUERY_AXIS, make_mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join this process to the multi-host runtime (the reference's
+    ``MPI_Init``, knn_mpi.cpp:123).  No-op when single-process or already
+    initialized, so driver code can call it unconditionally."""
+    if num_processes is None or num_processes <= 1:
+        return
+    # already-joined guard WITHOUT jax.process_count(): that call would
+    # initialize the local backend first, after which distributed init
+    # can no longer succeed
+    try:
+        from jax._src import distributed as _distributed
+
+        if getattr(_distributed.global_state, "client", None) is not None:
+            return
+    except ImportError:  # internal layout moved; fall through to init
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(
+    query_shards: Optional[int] = None, db_shards: int = 1
+) -> Mesh:
+    """The (query, db) mesh over every device of every host — the
+    reference's ``MPI_COMM_WORLD`` (knn_mpi.cpp:124-125)."""
+    return make_mesh(query_shards, db_shards, devices=jax.devices())
+
+
+def shard_across_hosts(
+    local_rows: np.ndarray,
+    mesh: Mesh,
+    axis_name: str = DB_AXIS,
+) -> jax.Array:
+    """Assemble per-host row blocks into one global ``jax.Array`` sharded
+    along ``axis_name`` — the multi-host ``MPI_Scatter`` (knn_mpi.cpp:
+    226-227) with no root: every host contributes the rows it already has,
+    concatenated in process order.  Row counts must be equal across hosts
+    (pad with :func:`knn_tpu.parallel.mesh.pad_to_multiple` first, and pass
+    the true pre-pad row count to ``ShardedKNN(..., n_train=...)`` so pad
+    rows stay masked); the global row count is
+    ``local_rows.shape[0] * process_count``.
+
+    Single-process, this is exactly a sharded ``device_put``.
+    """
+    local_rows = np.asarray(local_rows)
+    pc = jax.process_count()
+    axis_size = int(np.prod([mesh.shape[a] for a in (
+        (axis_name,) if isinstance(axis_name, str) else axis_name
+    )]))
+    if axis_size % pc:
+        raise ValueError(
+            f"mesh axis {axis_name!r} (size {axis_size}) must be a multiple "
+            f"of process_count={pc} to scatter rows across hosts; with fewer "
+            "shards than processes the array would be replicated and every "
+            "host would need the full matrix"
+        )
+    spec = [None] * local_rows.ndim
+    spec[0] = axis_name
+    sharding = NamedSharding(mesh, P(*spec))
+    global_shape = (
+        local_rows.shape[0] * pc,
+        *local_rows.shape[1:],
+    )
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, global_shape
+    )
+
+
+def process_row_slice(n_global_rows: int) -> slice:
+    """Which contiguous rows of a [N, D] global matrix this process should
+    load from disk — the per-rank read assignment the reference hard-codes
+    by rank id (knn_mpi.cpp:154-222).  Rows must already be padded to a
+    multiple of process_count."""
+    pc = jax.process_count()
+    if n_global_rows % pc:
+        raise ValueError(
+            f"{n_global_rows} rows not divisible by {pc} processes; pad first"
+        )
+    per = n_global_rows // pc
+    pid = jax.process_index()
+    return slice(pid * per, (pid + 1) * per)
+
+
+__all__ = [
+    "initialize",
+    "global_mesh",
+    "shard_across_hosts",
+    "process_row_slice",
+]

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from knn_tpu.ops.normalize import local_minmax, minmax_apply
 from knn_tpu.ops.topk import knn_search_tiled, merge_topk, topk_pairs
@@ -190,15 +190,44 @@ class ShardedKNN:
         compute_dtype=None,
         labels=None,
         num_classes: Optional[int] = None,
+        n_train: Optional[int] = None,
     ):
         if merge not in _MERGES:
             raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
         db_shards = mesh.shape[DB_AXIS]
-        if not isinstance(train, jax.Array):
-            train = np.asarray(train)  # keep on host; padding + placement stream shards
-        # host copy (unpadded) for certified-path float64 refinement
-        self._train_host = train if isinstance(train, np.ndarray) else None
-        tp, n_train = pad_to_multiple(train, db_shards)
+        pre_placed = (
+            isinstance(train, jax.Array)
+            and train.sharding.is_equivalent_to(
+                NamedSharding(mesh, P(DB_AXIS)), train.ndim
+            )
+        )
+        if pre_placed:
+            # already a db-sharded global array (e.g. assembled across
+            # hosts by parallel.multihost.shard_across_hosts) — use the
+            # placement as-is.  ``n_train`` tells the search programs how
+            # many leading rows are real when the caller padded before
+            # placing (pad rows past n_train are masked out of every
+            # selection, exactly like the host-array path).
+            if train.shape[0] % db_shards:
+                raise ValueError(
+                    f"pre-placed train rows {train.shape[0]} must be a "
+                    f"multiple of db_shards={db_shards}; pad before placing"
+                )
+            self._train_host = None
+            tp = train
+            n_train = train.shape[0] if n_train is None else n_train
+            if not 0 < n_train <= train.shape[0]:
+                raise ValueError(
+                    f"n_train={n_train} outside (0, {train.shape[0]}]"
+                )
+        else:
+            if n_train is not None:
+                raise ValueError("n_train is only for pre-placed arrays")
+            if not isinstance(train, jax.Array):
+                train = np.asarray(train)  # host padding streams shards on placement
+            # host copy (unpadded) for certified-path float64 refinement
+            self._train_host = train if isinstance(train, np.ndarray) else None
+            tp, n_train = pad_to_multiple(train, db_shards)
         shard_rows = tp.shape[0] // db_shards
         if k > shard_rows:
             raise ValueError(
@@ -261,6 +290,12 @@ class ShardedKNN:
         fetched from the mesh once and cached when the caller didn't keep
         a host array around."""
         if self._train_host is None:
+            if not self._tp.is_fully_addressable:
+                raise ValueError(
+                    "certified search needs a host copy of the database, but "
+                    "the pre-placed global array spans multiple processes; "
+                    "construct ShardedKNN from a host array instead"
+                )
             self._train_host = np.asarray(self._tp)[: self.n_train]
         return self._train_host
 

@@ -34,7 +34,25 @@ T0 = time.time()
 log("importing jax / acquiring device claim (may block a long time)...")
 import jax  # noqa: E402
 
-devs = jax.devices()
+#: the relay intermittently answers UNAVAILABLE (or blocks) while a stale
+#: claim drains; retry forever — this process is the round's one shot at
+#: the chip and an early exit wastes the wait already paid
+devs = None
+attempt = 0
+while devs is None:
+    attempt += 1
+    try:
+        devs = jax.devices()
+    except RuntimeError as e:
+        log(f"attempt {attempt}: init failed ({str(e)[:120]}); retrying in 120s")
+        try:
+            jax.clear_caches()
+            from jax._src import xla_bridge
+
+            xla_bridge.backends.cache_clear()
+        except Exception:
+            pass
+        time.sleep(120)
 log(f"devices: {devs} backend={jax.default_backend()} "
     f"kind={getattr(devs[0], 'device_kind', '?')}")
 

@@ -1,0 +1,91 @@
+"""Multi-host helpers (parallel.multihost) on the single-process 8-device
+CPU mesh: process-spanning semantics degenerate to the local case, which
+pins the contracts (global shapes, shardings, ShardedKNN pre-placed path)
+that a real pod run relies on."""
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from knn_tpu.parallel import DB_AXIS, ShardedKNN, make_mesh
+from knn_tpu.parallel.multihost import (
+    global_mesh,
+    initialize,
+    process_row_slice,
+    shard_across_hosts,
+)
+
+
+def test_initialize_single_process_noop():
+    initialize()  # num_processes None
+    initialize(num_processes=1)  # explicit single process
+    assert jax.process_count() == 1
+
+
+def test_global_mesh_spans_all_devices():
+    mesh = global_mesh(4, 2)
+    assert mesh.devices.size == 8
+    assert mesh.shape == {"query": 4, "db": 2}
+
+
+def test_process_row_slice_covers_everything():
+    sl = process_row_slice(64)
+    assert sl == slice(0, 64)  # single process owns all rows
+
+
+def test_shard_across_hosts_places_db_sharded(rng):
+    mesh = global_mesh(4, 2)
+    local = rng.normal(size=(16, 5)).astype(np.float32)
+    arr = shard_across_hosts(local, mesh, DB_AXIS)
+    assert arr.shape == (16, 5)  # 1 process: global == local
+    assert arr.sharding.is_equivalent_to(NamedSharding(mesh, P(DB_AXIS)), 2)
+    np.testing.assert_array_equal(np.asarray(arr), local)
+
+
+def test_sharded_knn_accepts_pre_placed_global_array(rng):
+    mesh = make_mesh(4, 2)
+    db = rng.normal(size=(128, 12)).astype(np.float32)
+    q = rng.normal(size=(20, 12)).astype(np.float32)
+    ref_d, ref_i = ShardedKNN(db, mesh=mesh, k=7).search(q)
+
+    placed = shard_across_hosts(db, mesh, DB_AXIS)
+    prog = ShardedKNN(placed, mesh=mesh, k=7)
+    d, i = prog.search(q)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+    np.testing.assert_array_equal(np.asarray(d), np.asarray(ref_d))
+
+
+def test_replicated_placement_flows_through_normal_path(rng):
+    mesh = make_mesh(4, 2)
+    db = rng.normal(size=(15, 4)).astype(np.float32)
+    placed = jax.device_put(
+        db, NamedSharding(mesh, P())
+    )  # replicated, not db-sharded -> treated as a plain array
+    prog = ShardedKNN(placed, mesh=mesh, k=3)
+    assert prog.n_train == 15
+
+
+def test_pre_placed_n_train_masks_pad_rows(rng):
+    # caller pads to the shard multiple before placing; n_train tells the
+    # programs the true row count so zero-pad rows can never win.  Pads are
+    # all-zero rows, which WOULD win under cosine-normalized data if
+    # unmasked (distance ||q||^2 to everything).
+    import pytest
+
+    mesh = make_mesh(4, 2)
+    db = rng.normal(size=(13, 6)).astype(np.float32)
+    q = rng.normal(size=(9, 6)).astype(np.float32)
+    ref_d, ref_i = ShardedKNN(db, mesh=mesh, k=4).search(q)
+
+    padded = np.zeros((14, 6), np.float32)
+    padded[:13] = db
+    placed = jax.device_put(padded, NamedSharding(mesh, P(DB_AXIS)))
+    prog = ShardedKNN(placed, mesh=mesh, k=4, n_train=13)
+    d, i = prog.search(q)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+    assert (np.asarray(i) < 13).all()
+
+    with pytest.raises(ValueError, match="outside"):
+        ShardedKNN(placed, mesh=mesh, k=4, n_train=15)
+    with pytest.raises(ValueError, match="only for pre-placed"):
+        ShardedKNN(db, mesh=mesh, k=4, n_train=13)
