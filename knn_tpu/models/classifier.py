@@ -80,6 +80,15 @@ class KNNClassifier:
         materialize the full |Q|x|T| distance matrix per batch).
       batch_size: queries per compiled step (tail batch is padded).
       compute_dtype: matmul input dtype, e.g. jnp.bfloat16 for MXU speed.
+      mesh: a ``jax.sharding.Mesh`` from :func:`knn_tpu.parallel.make_mesh`
+        — fit places the database across it once and every predict/
+        kneighbors runs the sharded SPMD program (parallel.ShardedKNN).
+        None = single-device jitted path (identical results).
+      merge: db-axis merge strategy when meshed ('allgather' | 'ring').
+      mode: 'exact' | 'certified' (meshed, l2 only) — certified runs the
+        coarse+certificate pipeline; results are still exact.
+      selector: coarse selector for certified mode ('approx' | 'pallas' |
+        'exact').
     """
 
     def __init__(
@@ -91,7 +100,15 @@ class KNNClassifier:
         train_tile: Optional[int] = None,
         batch_size: Optional[int] = None,
         compute_dtype=None,
+        mesh=None,
+        merge: str = "allgather",
+        mode: str = "exact",
+        selector: str = "approx",
     ):
+        if mode not in ("exact", "certified"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if mode == "certified" and mesh is None:
+            raise ValueError("mode='certified' needs a mesh (make_mesh(1, 1) is fine)")
         self.k = k
         self.metric = metric
         self.num_classes = num_classes
@@ -99,10 +116,15 @@ class KNNClassifier:
         self.train_tile = train_tile
         self.batch_size = batch_size
         self.compute_dtype = compute_dtype
+        self.mesh = mesh
+        self.merge = merge
+        self.mode = mode
+        self.selector = selector
         self._train = None
         self._labels = None
         self._mins = None
         self._maxs = None
+        self._program = None
 
     # -- fit ---------------------------------------------------------------
     def fit(self, X, y) -> "KNNClassifier":
@@ -119,6 +141,16 @@ class KNNClassifier:
             X = minmax_apply(X, self._mins, self._maxs)
         self._train = X
         self._labels = y
+        if self.mesh is not None:
+            from knn_tpu.parallel.sharded import ShardedKNN
+
+            # placed once; every predict/kneighbors reuses the placement
+            self._program = ShardedKNN(
+                np.asarray(X), mesh=self.mesh, k=self.k, metric=self.metric,
+                merge=self.merge, train_tile=self.train_tile,
+                compute_dtype=self.compute_dtype,
+                labels=np.asarray(y), num_classes=self.num_classes,
+            )
         return self
 
     def _require_fit(self):
@@ -155,6 +187,14 @@ class KNNClassifier:
         """Predicted labels [Q] — the reference's KNN phase + vote."""
         self._require_fit()
         Q = self._prep_queries(Q)
+        if self._program is not None:
+            if self.mode == "certified":
+                labels, _ = self._program.predict_certified(
+                    np.asarray(Q), selector=self.selector,
+                    batch_size=self.batch_size,
+                )
+                return jnp.asarray(labels)
+            return self._batched(Q, self._program.predict, 1)
         return self._batched(
             Q,
             lambda c: knn_predict(
@@ -174,6 +214,14 @@ class KNNClassifier:
         """(distances, indices) of the k nearest neighbors per query."""
         self._require_fit()
         Q = self._prep_queries(Q)
+        if self._program is not None:
+            if self.mode == "certified":
+                d, i, _ = self._program.search_certified(
+                    np.asarray(Q), selector=self.selector,
+                    batch_size=self.batch_size,
+                )
+                return jnp.asarray(d), jnp.asarray(i)
+            return self._batched(Q, self._program.search, 2)
         return self._batched(
             Q,
             lambda c: knn_kneighbors(

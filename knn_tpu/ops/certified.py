@@ -177,6 +177,7 @@ def repair_uncertified(
     select_fn,
     count_fn,
     max_widen: int,
+    db_norm_max: Optional[float] = None,
 ) -> int:
     """Shared fallback repair for both certified pipelines (single-device
     :func:`knn_search_certified` and the sharded
@@ -203,7 +204,9 @@ def repair_uncertified(
     fi = select_fn(q_np[bad], widen)
     fd2, fi2 = refine_exact(db_np, q_np[bad], np.asarray(fi), k)
     d[bad], i[bad] = fd2, fi2
-    thr2 = fd2[:, k - 1] + certification_tolerance(q_np[bad], db_np)
+    thr2 = fd2[:, k - 1] + certification_tolerance(
+        q_np[bad], db_np, db_norm_max=db_norm_max
+    )
     counts2 = np.asarray(count_fn(q_np[bad], thr2))
     still = np.flatnonzero(counts2 > k)
     if still.size:
@@ -255,7 +258,10 @@ def knn_search_certified(
     d, i = refine_exact(db_np, queries_np, np.asarray(cand), k)
 
     # certification threshold: kth true distance plus the f32 error bound
-    thresholds = d[:, k - 1] + certification_tolerance(queries_np, db_np)
+    db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
+    thresholds = d[:, k - 1] + certification_tolerance(
+        queries_np, db_np, db_norm_max=db_norm_max
+    )
     counts = np.asarray(count_below(db_j, q_j, jnp.asarray(thresholds), tile=tile))
 
     bad = np.flatnonzero(counts > k)
@@ -268,6 +274,7 @@ def knn_search_certified(
             db_j, jnp.asarray(qb), jnp.asarray(thr), tile=tile
         ),
         max_widen=n,
+        db_norm_max=db_norm_max,
     )
     stats = {"fallback_queries": int(bad.size), "certified": n_q - int(bad.size)}
     if host_exact:

@@ -238,6 +238,7 @@ class ShardedKNN:
         self.mesh = mesh
         self.k = k
         self.metric = metric
+        self._db_norm_max_cache: Optional[float] = None
         self.merge = merge
         self.train_tile = train_tile
         self.n_train = n_train
@@ -299,6 +300,17 @@ class ShardedKNN:
             self._train_host = np.asarray(self._tp)[: self.n_train]
         return self._train_host
 
+    def _db_norm_max(self) -> float:
+        """Largest float64 squared row norm of the database — the
+        query-independent half of the certificate tolerance; a full-DB
+        float64 pass, so computed once per placement and cached."""
+        if self._db_norm_max_cache is None:
+            db = self._host_train()
+            self._db_norm_max_cache = float(
+                (db.astype(np.float64) ** 2).sum(-1).max()
+            )
+        return self._db_norm_max_cache
+
     def search_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None,
@@ -348,9 +360,9 @@ class ShardedKNN:
         if batch_size is not None and batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         bs = n_q if batch_size is None else batch_size
-        # hoisted: the db-side term of the certificate tolerance is
-        # query-independent (a float64 pass over all N rows)
-        db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
+        # the db-side term of the certificate tolerance is query-independent
+        # and cached across calls (a float64 pass over all N rows)
+        db_norm_max = self._db_norm_max()
         batches = []
         for lo in range(0, n_q, bs):
             chunk = q_np[lo : lo + bs]
@@ -413,6 +425,7 @@ class ShardedKNN:
             d, i, self.k, m, bad, q_np, db_np,
             select_fn=_select, count_fn=_count,
             max_widen=min(self.n_train, shard_rows),
+            db_norm_max=db_norm_max,
         )
         stats = {
             "fallback_queries": int(bad.size),

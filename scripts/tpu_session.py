@@ -95,7 +95,7 @@ def pallas_proof():
     qj, dbj = jnp.asarray(q), jnp.asarray(db)
 
     def timeit(name, fn, reps=5):
-        fn()  # warm/compile
+        jax.tree_util.tree_leaves(fn())[0].block_until_ready()  # warm/compile
         t0 = time.time()
         for _ in range(reps):
             r = fn()
@@ -120,9 +120,6 @@ def pallas_proof():
 def run_bench(config):
     os.environ["KNN_BENCH_CONFIG"] = config
     sys.argv = ["bench.py"]
-    import importlib
-    import bench
-    importlib.reload(bench)  # re-read env-driven config
 
     import io
     from contextlib import redirect_stdout
@@ -131,6 +128,12 @@ def run_bench(config):
     log(f"bench[{config}]: starting ...")
     try:
         with redirect_stdout(buf):
+            # reload inside the capture + SystemExit guard: bench's
+            # module-level config parse emits its error JSON and exits
+            import importlib
+            import bench
+
+            importlib.reload(bench)  # re-read env-driven config
             bench.main()
     except SystemExit as e:
         log(f"bench[{config}] exited rc={e.code}")

@@ -1,0 +1,86 @@
+"""KNNClassifier estimator: the reference job as fit/predict, plus the
+meshed (ShardedKNN-backed) and certified execution modes — all four
+execution strategies must emit identical labels."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from knn_tpu import KNNClassifier
+from knn_tpu.data.datasets import make_blobs
+from knn_tpu.parallel import make_mesh
+
+
+@pytest.fixture
+def data(rng):
+    feats, labels = make_blobs(400, 8, 4, cluster_std=0.6, seed=2)
+    return feats[:300], labels[:300], feats[300:], labels[300:]
+
+
+def test_fit_predict_score(data):
+    X, y, Q, yq = data
+    clf = KNNClassifier(k=7, normalize=True, batch_size=32)
+    acc = clf.fit(X, y).score(Q, yq)
+    assert acc > 0.9
+    d, i = clf.kneighbors(Q)
+    assert d.shape == (100, 7) and i.shape == (100, 7)
+
+
+def test_meshed_matches_single_device(data):
+    X, y, Q, _ = data
+    base = KNNClassifier(k=7, normalize=True).fit(X, y)
+    ref = np.asarray(base.predict(Q))
+    for mesh_shape, merge in (((4, 2), "allgather"), ((2, 4), "ring")):
+        clf = KNNClassifier(
+            k=7, normalize=True, mesh=make_mesh(*mesh_shape), merge=merge,
+            batch_size=64,
+        ).fit(X, y)
+        np.testing.assert_array_equal(np.asarray(clf.predict(Q)), ref)
+        d, i = clf.kneighbors(Q)
+        db, ib = base.kneighbors(Q)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ib))
+
+
+def test_certified_mode_matches_exact(data):
+    X, y, Q, _ = data
+    ref = np.asarray(KNNClassifier(k=7, normalize=True).fit(X, y).predict(Q))
+    clf = KNNClassifier(
+        k=7, normalize=True, mesh=make_mesh(4, 2), mode="certified",
+        batch_size=33,
+    ).fit(X, y)
+    np.testing.assert_array_equal(np.asarray(clf.predict(Q)), ref)
+    d, i = clf.kneighbors(Q)
+    assert i.shape == (100, 7)
+
+
+def test_certified_requires_mesh():
+    with pytest.raises(ValueError, match="needs a mesh"):
+        KNNClassifier(mode="certified")
+    with pytest.raises(ValueError, match="unknown mode"):
+        KNNClassifier(mode="fast")
+
+
+def test_errors(data):
+    X, y, Q, _ = data
+    clf = KNNClassifier(k=5)
+    with pytest.raises(RuntimeError, match="fit"):
+        clf.predict(Q)
+    with pytest.raises(ValueError, match="k="):
+        KNNClassifier(k=10_000).fit(X, y)
+    clf.fit(X, y)
+    with pytest.raises(ValueError, match="queries"):
+        clf.predict(Q[:, :3])
+
+
+def test_tie_semantics_duplicate_rows(rng):
+    # identical train rows with different labels: the vote must follow the
+    # reference's first-to-reach-max rule via the lexicographic neighbor
+    # order (lowest index first among equal distances)
+    X = np.zeros((6, 4), np.float32)
+    y = np.array([2, 1, 1, 0, 0, 0], np.int32)
+    Q = np.zeros((1, 4), np.float32)
+    # k=3: neighbors are rows 0,1,2 (indices tie-break) -> labels 2,1,1 -> 1
+    pred = KNNClassifier(k=3).fit(X, y).predict(Q)
+    assert int(pred[0]) == 1
+    meshed = KNNClassifier(k=3, mesh=make_mesh(4, 2)).fit(X, y).predict(Q)
+    assert int(meshed[0]) == 1
