@@ -41,6 +41,8 @@ Env overrides:
   KNN_BENCH_DTYPE    (bfloat16 | float32; default per config)
   KNN_BENCH_PEAK_FLOPS    override the per-chip peak used for MFU
   KNN_BENCH_PLATFORM      force a JAX platform (e.g. "cpu") before init
+  KNN_BENCH_TRACE         write a jax.profiler trace of each mode's last run
+                          under this directory (TensorBoard-viewable)
   KNN_BENCH_INIT_TIMEOUT  seconds before backend init is declared hung (480)
   KNN_BENCH_FALLBACK_CPU=1  run on CPU if accelerator init fails (the JSON
                             records backend+device so the number stays honest)
@@ -326,6 +328,7 @@ def main() -> None:
     #: rare, per-run stats record it)
     passes = {"exact": 1, "certified_approx": 2, "certified_pallas": 2}
 
+    trace_dir = os.environ.get("KNN_BENCH_TRACE")
     results = {}
     for mode in modes:
         entry = {}
@@ -341,6 +344,15 @@ def main() -> None:
                 t0 = time.perf_counter()
                 _, stats = fn(queries)
                 times.append(time.perf_counter() - t0)
+            if trace_dir:
+                # one extra instrumented run, OUTSIDE the timed stats —
+                # profiler overhead must not skew the headline numbers
+                from jax.profiler import trace as _trace
+
+                with _trace(os.path.join(trace_dir, mode)):
+                    t0 = time.perf_counter()
+                    fn(queries)
+                    entry["traced_run_s"] = round(time.perf_counter() - t0, 4)
             times = np.asarray(times)
             qps = NQ / times
             flops = 2.0 * NQ * N * DIM * passes[mode]

@@ -109,6 +109,8 @@ class KNNClassifier:
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "certified" and mesh is None:
             raise ValueError("mode='certified' needs a mesh (make_mesh(1, 1) is fine)")
+        if mode == "certified" and metric not in ("l2", "sql2", "euclidean"):
+            raise ValueError("mode='certified' supports the l2 metric only")
         self.k = k
         self.metric = metric
         self.num_classes = num_classes
@@ -141,6 +143,7 @@ class KNNClassifier:
             X = minmax_apply(X, self._mins, self._maxs)
         self._train = X
         self._labels = y
+        self._program = None  # a refit must never serve the old placement
         if self.mesh is not None:
             from knn_tpu.parallel.sharded import ShardedKNN
 

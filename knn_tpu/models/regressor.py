@@ -30,7 +30,14 @@ def knn_regress(
     dists, idx = knn_search_tiled(
         queries, train, k, metric, train_tile=train_tile, compute_dtype=compute_dtype
     )
-    targets = train_targets[idx].astype(jnp.float32)  # [Q, k] or [Q, k, out]
+    return _weighted_targets(dists, train_targets[idx], weights)
+
+
+def _weighted_targets(dists, targets, weights: str):
+    """Reduce [Q, k] neighbor targets to predictions — the one place the
+    uniform/inverse-distance weighting lives (single-device and meshed
+    paths share it)."""
+    targets = targets.astype(jnp.float32)  # [Q, k] or [Q, k, out]
     if weights == "uniform":
         return jnp.mean(targets, axis=1)
     if weights == "distance":
@@ -43,7 +50,12 @@ def knn_regress(
 
 
 class KNNRegressor:
-    """fit/predict regressor over the same tiled KNN core as the classifier."""
+    """fit/predict regressor over the same tiled KNN core as the classifier.
+
+    ``mesh`` places the database across devices once (parallel.ShardedKNN)
+    and predicts via the sharded search + a host-side weighted reduction —
+    same results as the single-device path.
+    """
 
     def __init__(
         self,
@@ -52,14 +64,21 @@ class KNNRegressor:
         weights: str = "uniform",
         train_tile: Optional[int] = None,
         compute_dtype=None,
+        mesh=None,
+        merge: str = "allgather",
     ):
+        if weights not in ("uniform", "distance"):
+            raise ValueError(f"unknown weights {weights!r}")
         self.k = k
         self.metric = metric
         self.weights = weights
         self.train_tile = train_tile
         self.compute_dtype = compute_dtype
+        self.mesh = mesh
+        self.merge = merge
         self._train = None
         self._targets = None
+        self._program = None
 
     def fit(self, X, y) -> "KNNRegressor":
         X = jnp.asarray(X)
@@ -69,11 +88,25 @@ class KNNRegressor:
         if self.k > X.shape[0]:
             raise ValueError(f"k={self.k} > n_train={X.shape[0]}")
         self._train, self._targets = X, y
+        self._program = None  # a refit must never serve the old placement
+        if self.mesh is not None:
+            from knn_tpu.parallel.sharded import ShardedKNN
+
+            import numpy as np
+
+            self._program = ShardedKNN(
+                np.asarray(X), mesh=self.mesh, k=self.k, metric=self.metric,
+                merge=self.merge, train_tile=self.train_tile,
+                compute_dtype=self.compute_dtype,
+            )
         return self
 
     def predict(self, Q) -> jax.Array:
         if self._train is None:
             raise RuntimeError("call fit() first")
+        if self._program is not None:
+            dists, idx = self._program.search(jnp.asarray(Q))
+            return _weighted_targets(dists, self._targets[idx], self.weights)
         return knn_regress(
             self._train,
             self._targets,

@@ -84,3 +84,18 @@ def test_tie_semantics_duplicate_rows(rng):
     assert int(pred[0]) == 1
     meshed = KNNClassifier(k=3, mesh=make_mesh(4, 2)).fit(X, y).predict(Q)
     assert int(meshed[0]) == 1
+
+
+def test_refit_without_mesh_drops_old_program(data):
+    X, y, Q, _ = data
+    clf = KNNClassifier(k=5, mesh=make_mesh(4, 2)).fit(X, y)
+    clf.mesh = None
+    X2 = X + 100.0  # shifted database: predictions must come from X2
+    clf.fit(X2, y)
+    ref = np.asarray(KNNClassifier(k=5).fit(X2, y).predict(Q))
+    np.testing.assert_array_equal(np.asarray(clf.predict(Q)), ref)
+
+
+def test_certified_rejects_non_l2_at_construction():
+    with pytest.raises(ValueError, match="l2 metric only"):
+        KNNClassifier(metric="l1", mode="certified", mesh=object())

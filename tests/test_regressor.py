@@ -58,3 +58,21 @@ def test_regressor_errors(rng):
         KNNRegressor(k=11).fit(X, y)
     with pytest.raises(ValueError, match="weights"):
         knn_regress(jnp.asarray(X), jnp.asarray(y), jnp.asarray(X[:2]), k=2, weights="quadratic")
+
+
+def test_meshed_regressor_matches_single_device(rng):
+    from knn_tpu.parallel import make_mesh
+
+    X = rng.normal(size=(200, 10)).astype(np.float32)
+    y = rng.normal(size=(200,)).astype(np.float32)
+    Q = rng.normal(size=(30, 10)).astype(np.float32)
+    for weights, rtol in (("uniform", 0), ("distance", 1e-4)):
+        # uniform: identical neighbor sets -> identical means.  distance:
+        # the sharded matmul partitions the reduction differently, so
+        # distances (and the 1/d weights) differ by float32 ulps
+        ref = np.asarray(KNNRegressor(k=6, weights=weights).fit(X, y).predict(Q))
+        got = np.asarray(
+            KNNRegressor(k=6, weights=weights, mesh=make_mesh(4, 2), merge="ring")
+            .fit(X, y).predict(Q)
+        )
+        np.testing.assert_allclose(got, ref, rtol=rtol)
