@@ -86,9 +86,11 @@ class KNNClassifier:
         None = single-device jitted path (identical results).
       merge: db-axis merge strategy when meshed ('allgather' | 'ring').
       mode: 'exact' | 'certified' (meshed, l2 only) — certified runs the
-        coarse+certificate pipeline; results are still exact.
+        coarse+certificate pipeline; neighbor indices (and hence labels)
+        are still exact.
       selector: coarse selector for certified mode ('approx' | 'pallas' |
-        'exact').
+        'exact').  The pallas selector returns f32-accurate kneighbors
+        distances (see ShardedKNN.search_certified); the others float64.
     """
 
     def __init__(

@@ -1,30 +1,57 @@
-"""Pallas TPU kernel: fused distance + bin-min candidate generation.
+"""Pallas TPU kernel: fused distance + top-s-per-bin candidates + exclusion
+bound — a *self-certifying* coarse pass.
 
 The hot loop of the whole framework is ``query x database`` distance +
 neighbor selection (the reference burns it in a scalar loop + full sort,
-knn_mpi.cpp:317-323).  The XLA path (ops.topk) is already matmul-based but
-selection-bound: ``lax.top_k`` over wide tiles dominates the runtime.
-This kernel fuses the two so the distance tile never round-trips to HBM:
+knn_mpi.cpp:317-323).  The XLA exact path is selection-bound: ``lax.top_k``
+over a 1M-wide distance row costs ~30x the distance matmul.  This kernel
+fuses distance + a hierarchical reduction so the [Q, N] distance matrix
+never reaches HBM, and emits everything the certified pipeline needs in
+ONE database pass:
 
-  per grid cell (query block i, db tile j):
-    1. MXU:  qt = Q_i @ T_j^T            (bf16 inputs, f32 accumulate)
-    2. VPU:  d  = ||t||^2 - 2 qt         (+||q||^2 dropped: per-query
-                                          constant, rank-invariant)
-    3. VPU:  per 128-wide bin, min + argmin  ->  [BQ, L] candidates
+  per grid cell (query block i, db tile j, dim chunk c):
+    1. MXU:  qt += Q_ic @ T_jc^T          (f32, accumulated in VMEM scratch
+                                           across dim chunks)
+    2. MXU:  tn += 1 @ (T_jc * T_jc)^T    (db row norms, same accumulation)
+    at the last dim chunk:
+    3. VPU:  s = tn - 2 qt                (squared L2 minus ||q||^2: the
+                                           per-query constant is rank- and
+                                           certificate-irrelevant)
+    4. VPU:  per 128-wide bin, the s smallest values + their indices
+             (candidates) AND the (s+1)-th smallest value (the *exclusion
+             bound*: no non-candidate in this bin can score below it)
 
-Only L candidates per tile leave VMEM (L = tile/128), a ~128x reduction in
-HBM writes vs materializing the distance matrix.  The candidates then go
-through one *small* device-side lexicographic top-m, and exactness is
-restored by the certified pipeline (ops.certified: float64 refine +
-count-below certificate + exact fallback) — the kernel itself only has to
-be *probably* right, never wrong silently.
+Outputs per (i, j) cell are exactly 128 lanes wide — survivors are
+concatenated across bins (``s * n_bins = 128``) — so every block satisfies
+the TPU's lane-alignment rule (the round-2 kernel's (256, 16) output block
+failed to lower for exactly this reason).  The per-bin exclusion bounds are
+min-accumulated across db tiles in-kernel (output revisiting), so the whole
+bound side-channel costs one [Q, 128] array.
 
-This is the same shape as the ApproxTopK/PartialReduce design (TPU-KNN
-paper, PAPERS.md) but as an explicit Pallas kernel: the bin reduction
-fuses with the distance computation instead of running on a materialized
-score matrix.
+Why top-2 per bin (the default): with 1M rows in 7813 bins, two true
+top-100 neighbors share a bin for ~47% of queries — a 1-survivor kernel
+falls back constantly (the round-2 failure mode).  Three sharing one bin
+happens ~0.3% of the time: top-2 makes the certified fast path the common
+case, and the bound makes every miss *detectable*:
 
-Runs in interpret mode off-TPU so the CPU test suite covers it.
+  a point t outside the candidate set either (a) lost its bin's top-s —
+  then s32(t) >= bound_b >= B, or (b) its bin entry lost the final
+  top-(m+1) — then s32(t) >= v_excl >= B, where B = min(all bin bounds,
+  v_excl).  With |s32 - s_true| <= tol, ``s_k_true < B - tol`` proves no
+  true neighbor is missing — certified exact, NO separate count pass
+  (ops.certified's count-below matmul becomes redundant on this path).
+
+The kernel computes in float32 (precision configurable) because the
+certificate's tolerance must be float32-tight; a bf16 coarse pass would
+blur v_excl by ~1000x the k-th/(k+1)-th distance gap and never certify.
+
+This is the ApproxTopK/PartialReduce shape (TPU-KNN paper, PAPERS.md) made
+exact: fused with the distance matmul, two survivors instead of one, and a
+sound exclusion bound instead of a recall target.
+
+Runs in interpret mode off-TPU so the CPU test suite covers it; the TPU
+session script (scripts/tpu_session.py) gates the *compiled* kernel against
+the float64 oracle before any benchmark run.
 """
 
 from __future__ import annotations
@@ -34,59 +61,180 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
-
-try:  # pltpu is importable off-TPU; guard anyway for exotic builds
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PLTPU = True
-except ImportError:  # pragma: no cover
-    pltpu = None
-    _HAS_PLTPU = False
+from jax.experimental.pallas import tpu as pltpu
 
 from knn_tpu.ops.topk import topk_pairs
 
-#: query rows per grid cell (MXU-aligned)
-BLOCK_Q = 256
-#: database rows per grid cell; VMEM cost ~ BLOCK_Q*TILE_N*4B for the
-#: distance tile (2 MB at 256 x 2048)
-TILE_N = 2048
-#: bin width — one candidate survives per bin (lane-aligned)
+#: bin width — the lane count; `survivors` candidates + one bound per bin
 BIN_W = 128
+#: query rows per grid cell (VMEM: the [BLOCK_Q, TILE_N] f32 score tile)
+BLOCK_Q = 64
+#: database rows per grid cell; with BIN_W=128 bins and 128-lane outputs,
+#: survivors = 128 // (TILE_N // BIN_W) = 2 per bin
+TILE_N = 8192
+#: dim is processed in chunks so arbitrarily wide features (GIST's 960)
+#: never blow VMEM; qt accumulates in scratch across chunks
+DIM_CHUNK = 128
+#: cap on survivors per bin (tiny tile_n in tests would otherwise unroll
+#: a 128-step trace); capped cells just pad their output block
+MAX_SURVIVORS = 8
+#: row-padding fill: huge positive so padded rows score astronomically far
+#: and can never become candidates or deflate a bin bound.  Soundness never
+#: depends on this (a deflated bound only causes a fallback); candidate
+#: sanity does, and 1.5e17 keeps ||pad||^2 finite in f32.
+PAD_VAL = 1.5e17
+
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+#: kernel matmul modes.  "bf16x3" is the default: q and t split into
+#: bf16 high/low parts, three MXU passes reconstruct the f32 product to
+#: ~2^-17 relative accuracy at half the cost of a native f32 HIGHEST
+#: matmul (Mosaic rejects Precision.HIGH, so the split is done by hand).
+#: "highest" is the native f32 path; "default" is for experiments only —
+#: its error is certificate-hostile (~2^-10 relative, measured).
+PRECISIONS = ("bf16x3", "highest", "default")
+
+#: relative slack of the device rank stage's direct-difference f32
+#: distances: per-term (q-t)^2 rounding plus the depth-7 tree reduce give
+#: |d32 - d| <= ~1.2e-6 * d; 2^-18 = 3.8e-6 is ~3x headroom.  Candidate
+#: pairs whose gap falls inside this band get a targeted float64
+#: correction on host (exactness never rests on the f32 rank).  At SIFT1M
+#: scale near-ties are COMMON — most queries have a few — so the
+#: correction is per-pair, never per-query.
+RANK_SLACK = 2.0 ** -18
 
 
-def _kernel(q_ref, t_ref, d_ref, i_ref, *, n_valid: int, tile_n: int,
-            compute_dtype):
-    j = pl.program_id(1)
+def _geometry(tile_n: int) -> Tuple[int, int]:
+    """(n_bins, survivors) for a db tile.  Output blocks are 128 lanes:
+    survivors * n_bins <= 128, padded with +inf/sentinel when the
+    MAX_SURVIVORS cap binds."""
+    if tile_n % BIN_W:
+        raise ValueError(f"tile_n={tile_n} must be a multiple of {BIN_W}")
+    n_bins = tile_n // BIN_W
+    if n_bins > 128:
+        raise ValueError(f"tile_n={tile_n} exceeds 128 bins per cell")
+    return n_bins, min(128 // n_bins, MAX_SURVIVORS, BIN_W)
+
+
+def _kernel(q_ref, t_ref, d_ref, i_ref, b_ref, *scratch,
+            tile_n: int, n_bins: int, survivors: int, nd: int, precision: str):
+    ti = pl.program_id(1)
+    di = pl.program_id(2)
     q = q_ref[:]
     t = t_ref[:]
-    t32 = t.astype(jnp.float32)
-    t_norm = jnp.sum(t32 * t32, axis=-1)[None, :]  # [1, T]
-    qt = lax.dot_general(
-        q.astype(compute_dtype),
-        t.astype(compute_dtype),
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [BQ, T]
-    d = t_norm - 2.0 * qt  # rank-equivalent to squared L2 (||q||^2 dropped)
+    dn = (((1,), (1,)), ((), ()))
+    if precision == "bf16x3":
+        qh = q.astype(jnp.bfloat16)
+        th = t.astype(jnp.bfloat16)
+        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        tl = (t - th.astype(jnp.float32)).astype(jnp.bfloat16)
+        # q.t = qh.th + qh.tl + ql.th (+ ql.tl dropped: <= 2^-18 |q||t|,
+        # covered by kernel_tolerance's 2^-14 factor)
+        qt = (lax.dot_general(qh, th, dn, preferred_element_type=jnp.float32)
+              + lax.dot_general(qh, tl, dn, preferred_element_type=jnp.float32)
+              + lax.dot_general(ql, th, dn, preferred_element_type=jnp.float32))
+    else:
+        prec = (lax.Precision.HIGHEST if precision == "highest"
+                else lax.Precision.DEFAULT)
+        qt = lax.dot_general(q, t, dn, preferred_element_type=jnp.float32,
+                             precision=prec)  # [BQ, T]
+    # db row norms via MXU so they land lane-major directly ([8, T]; row 0
+    # used) — no sublane->lane transpose needed.  Always f32 HIGHEST: the
+    # [8, dim] @ [dim, T] dot is ~1% of the qt matmul's cost.
+    ones = jnp.ones((8, t.shape[1]), jnp.float32)
+    tn = lax.dot_general(
+        ones, t * t, dimension_numbers=dn,
+        preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
+    )
 
-    # mask db padding rows (global col >= n_valid) out of every bin
-    col = j * tile_n + lax.broadcasted_iota(jnp.int32, d.shape, 1)
-    d = jnp.where(col < n_valid, d, jnp.inf)
+    if nd == 1:
+        # single dim chunk: no scratch allocated, skip the VMEM
+        # accumulation round-trip entirely (measured ~16% of kernel time
+        # at SIFT shape)
+        _emit_select(ti, qt, tn, d_ref, i_ref, b_ref,
+                     tile_n=tile_n, n_bins=n_bins, survivors=survivors)
+        return
+    qt_ref, tn_ref = scratch
 
-    bq = d.shape[0]
-    n_bins = tile_n // BIN_W
-    d3 = d.reshape(bq, n_bins, BIN_W)
-    bin_min = jnp.min(d3, axis=-1)  # [BQ, L]
-    bin_arg = jnp.argmin(d3, axis=-1).astype(jnp.int32)  # [BQ, L]
-    base = j * tile_n + lax.broadcasted_iota(jnp.int32, bin_min.shape, 1) * BIN_W
-    d_ref[:] = bin_min
-    i_ref[:] = base + bin_arg
+    @pl.when(di == 0)
+    def _init():
+        qt_ref[:] = qt
+        tn_ref[:] = tn
+
+    @pl.when(di > 0)
+    def _acc():
+        qt_ref[:] += qt
+        tn_ref[:] += tn
+
+    @pl.when(di == nd - 1)
+    def _select():
+        _emit_select(ti, qt_ref[:], tn_ref[:], d_ref, i_ref, b_ref,
+                     tile_n=tile_n, n_bins=n_bins, survivors=survivors)
+
+
+def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
+                 tile_n: int, n_bins: int, survivors: int):
+    """Binning + survivor/bound emission from an accumulated score tile
+    (shared by the single-chunk fast path and the multi-chunk tail;
+    ``ti`` is the db-tile program id, hoisted by the caller because
+    ``pl.program_id`` is unavailable inside a ``pl.when`` branch in
+    interpret mode)."""
+    s = tn[0:1, :] - 2.0 * qt  # [BQ, T], ||q||^2 dropped
+    bq = s.shape[0]
+    d3 = s.reshape(bq, n_bins, BIN_W)
+    lane = lax.broadcasted_iota(jnp.int32, d3.shape, 2)
+    base = (ti * tile_n
+            + lax.broadcasted_iota(jnp.int32, (bq, n_bins), 1) * BIN_W)
+    ds, is_ = [], []
+    work = d3
+    for _ in range(survivors):
+        mj = jnp.min(work, axis=-1)  # [BQ, n_bins]
+        aj = jnp.argmin(work, axis=-1).astype(jnp.int32)
+        ds.append(mj)
+        is_.append(jnp.where(jnp.isfinite(mj), base + aj, _I32MAX))
+        work = jnp.where(lane == aj[:, :, None], jnp.inf, work)
+    bound = jnp.min(work, axis=-1)  # (survivors+1)-th smallest per bin
+    cd = jnp.concatenate(ds, axis=-1)
+    ci = jnp.concatenate(is_, axis=-1)
+    pad = 128 - survivors * n_bins
+    if pad:
+        cd = jnp.concatenate(
+            [cd, jnp.full((bq, pad), jnp.inf, jnp.float32)], axis=-1)
+        ci = jnp.concatenate(
+            [ci, jnp.full((bq, pad), _I32MAX, jnp.int32)], axis=-1)
+    d_ref[:] = cd
+    i_ref[:] = ci
+    bpad = 128 - n_bins
+    if bpad:
+        bound = jnp.concatenate(
+            [bound, jnp.full((bq, bpad), jnp.inf, jnp.float32)], axis=-1)
+
+    @pl.when(ti == 0)
+    def _first():
+        b_ref[:] = bound
+
+    @pl.when(ti > 0)
+    def _min():
+        b_ref[:] = jnp.minimum(b_ref[:], bound)
+
+
+def _pad_axis(x, multiple: int, axis: int, fill: float = 0.0):
+    """parallel.mesh.pad_to_multiple without the size return (imported
+    lazily: ops must not import the parallel package at module scope)."""
+    from knn_tpu.parallel.mesh import pad_to_multiple
+
+    return pad_to_multiple(x, multiple, axis, fill=fill)[0]
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "tile_n", "compute_dtype", "interpret")
+    jax.jit, static_argnames=("block_q", "tile_n", "precision", "interpret")
 )
 def _bin_candidates(
     queries: jax.Array,
@@ -94,50 +242,147 @@ def _bin_candidates(
     *,
     block_q: int,
     tile_n: int,
-    compute_dtype,
+    precision: str,
     interpret: bool,
-) -> Tuple[jax.Array, jax.Array]:
-    """Padded-shape kernel launch: ([Qp, C] bin-min scores, [Qp, C] global
-    indices), C = (Np/tile_n) * (tile_n/BIN_W).  Scores are squared L2
-    minus ||q||^2 (per-query constant), so per-query ranking is intact."""
-    n_valid = db.shape[0]
-    qp = -(-queries.shape[0] // block_q) * block_q
-    np_ = -(-db.shape[0] // tile_n) * tile_n
-    if qp != queries.shape[0]:
-        queries = jnp.pad(queries, ((0, qp - queries.shape[0]), (0, 0)))
-    if np_ != db.shape[0]:
-        db = jnp.pad(db, ((0, np_ - db.shape[0]), (0, 0)))
-    n_tiles = np_ // tile_n
-    n_bins = tile_n // BIN_W
-    dim = queries.shape[1]
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Kernel launch on padded shapes.  Returns
 
+      cand_d [Qp, W]  f32  per-bin survivor scores (squared L2 - ||q||^2),
+      cand_i [Qp, W]  i32  their global db row indices (sentinel = i32 max),
+      bounds [Qp, 128] f32 per-bin-slot exclusion bounds, min-reduced over
+                           db tiles (lane-min for the scalar bound).
+
+    W = n_tiles * 128.  Zero dim-padding preserves scores exactly; PAD_VAL
+    row-padding scores ~1e36 so pads never surface (module docstring)."""
+    queries = _pad_axis(queries.astype(jnp.float32), block_q, 0)
+    queries = _pad_axis(queries, DIM_CHUNK, 1)
+    db = _pad_axis(db.astype(jnp.float32), tile_n, 0, fill=PAD_VAL)
+    db = _pad_axis(db, DIM_CHUNK, 1)
+    qp, dim = queries.shape
+    n_tiles = db.shape[0] // tile_n
+    nd = dim // DIM_CHUNK
+    n_bins, survivors = _geometry(tile_n)
+
+    if precision not in PRECISIONS:
+        raise ValueError(f"precision {precision!r} not in {PRECISIONS}")
     kernel = functools.partial(
-        _kernel, n_valid=n_valid, tile_n=tile_n, compute_dtype=compute_dtype
+        _kernel, tile_n=tile_n, n_bins=n_bins, survivors=survivors, nd=nd,
+        precision=precision,
     )
-    grid = (qp // block_q, n_tiles)
-    mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
-    d, i = pl.pallas_call(
+    grid = (qp // block_q, n_tiles, nd)
+    kwargs = {}
+    if not interpret:
+        # the [block_q, tile_n] f32 score tile + double-buffered db tile
+        # overflow the default 16 MB scoped-vmem budget at large n_tiles;
+        # v5e has headroom above it, and the explicit limit keeps the
+        # geometry (tile_n=8192 -> 2 survivors/bin) intact
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            vmem_limit_bytes=64 * 1024 * 1024,
+        )
+    return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_q, dim), lambda qi, ti: (qi, 0), **mem),
-            pl.BlockSpec((tile_n, dim), lambda qi, ti: (ti, 0), **mem),
+            pl.BlockSpec((block_q, DIM_CHUNK), lambda qi, ti, di: (qi, di)),
+            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
         ],
         out_specs=[
-            pl.BlockSpec((block_q, n_bins), lambda qi, ti: (qi, ti), **mem),
-            pl.BlockSpec((block_q, n_bins), lambda qi, ti: (qi, ti), **mem),
+            pl.BlockSpec((block_q, 128), lambda qi, ti, di: (qi, ti)),
+            pl.BlockSpec((block_q, 128), lambda qi, ti, di: (qi, ti)),
+            pl.BlockSpec((block_q, 128), lambda qi, ti, di: (qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((qp, n_tiles * n_bins), jnp.float32),
-            jax.ShapeDtypeStruct((qp, n_tiles * n_bins), jnp.int32),
+            jax.ShapeDtypeStruct((qp, n_tiles * 128), jnp.float32),
+            jax.ShapeDtypeStruct((qp, n_tiles * 128), jnp.int32),
+            jax.ShapeDtypeStruct((qp, 128), jnp.float32),
+        ],
+        # the accumulation scratch is only touched when dim spans multiple
+        # chunks; at dim <= 128 (the headline shape) skipping it returns
+        # ~2 MB of VMEM to the pipeline
+        scratch_shapes=[] if nd == 1 else [
+            pltpu.VMEM((block_q, tile_n), jnp.float32),
+            pltpu.VMEM((8, tile_n), jnp.float32),
         ],
         interpret=interpret,
+        **kwargs,
     )(queries, db)
-    return d, i
 
 
-def _on_tpu() -> bool:
-    return jax.default_backend() == "tpu"
+@functools.partial(
+    jax.jit,
+    static_argnames=("m", "tile_n", "block_q", "precision", "interpret"),
+)
+def local_certified_candidates(
+    q: jax.Array,
+    t: jax.Array,
+    m: int,
+    *,
+    tile_n: int = TILE_N,
+    block_q: int = BLOCK_Q,
+    precision: str = "bf16x3",
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The whole device-side certified coarse pass against one db (shard):
+
+      d32   [Q, m+1]  f32 direct-difference squared L2 of the selected
+                      candidates, lexicographically ordered with their
+      idx   [Q, m+1]  local db row indices (sentinel i32-max on padding),
+      lb    [Q]       kernel-space exclusion bound: every db row NOT among
+                      the selected candidates has kernel score >= lb.
+
+    Three stages, all on device:
+
+    1. fused kernel -> per-bin survivors + bin bounds;
+    2. ``approx_max_k`` picks ~(m+1) survivors; the *exact* min over the
+       de-selected survivors (one masked reduction) joins the bin bounds,
+       so the approximate selection cannot silently weaken the bound;
+    3. the selected rows are gathered and re-scored with direct-difference
+       f32 (no catastrophic cancellation — relative error ~1e-6, vs the
+       expanded-square kernel score's absolute error at ||q||^2 scale),
+       then ordered lexicographically by (distance, index).
+
+    Callable inside shard_map; parallel.sharded merges (d32, idx) across
+    db shards and pmin's lb."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    eff_tile = min(tile_n, max(BIN_W, -(-t.shape[0] // BIN_W) * BIN_W))
+    cd, ci, bounds = _bin_candidates(
+        q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
+        precision=precision, interpret=interpret,
+    )
+    n_q = q.shape[0]
+    cd, ci, bounds = cd[:n_q], ci[:n_q], bounds[:n_q]
+    w = cd.shape[1]
+    if m + 2 > w:
+        raise ValueError(
+            f"pallas selector: m+2={m + 2} exceeds {w} bin survivors on a "
+            f"{t.shape[0]}-row shard; lower margin or tile_n, or use the "
+            f"approx selector"
+        )
+    # exact top-(m+2) by kernel score: the last value is the exclusion
+    # value over every de-selected survivor (approx_max_k is NOT usable
+    # here — its per-element recall target means P(all top-k survive)
+    # decays exponentially in k, the round-2 fallback disease)
+    neg, sel = lax.top_k(-cd, m + 2)
+    vals = -neg
+    lidx = jnp.take_along_axis(ci, sel, axis=-1)[:, : m + 1]
+    lb = jnp.minimum(jnp.min(bounds, axis=-1), vals[:, m + 1])
+
+    # kernel-padding rows carry real-looking indices in [rows, padded);
+    # clip-gathering them would hand a PAD candidate the LAST REAL row's
+    # finite distance — mask them to sentinel BEFORE the rescore
+    valid = lidx < t.shape[0]
+    lidx = jnp.where(valid, lidx, _I32MAX)
+
+    # device rank stage: direct-difference f32 rescore of the selected rows
+    safe = jnp.clip(lidx, 0, t.shape[0] - 1)
+    rows = t[safe]  # [Q, m+1, D] gather
+    diff = q[:, None, :].astype(jnp.float32) - rows.astype(jnp.float32)
+    d32 = jnp.sum(diff * diff, axis=-1)
+    d32 = jnp.where(valid, d32, jnp.inf)
+    d32, lidx = topk_pairs(d32, lidx, m + 1)
+    return d32, lidx, lb
 
 
 def pallas_knn_candidates(
@@ -147,67 +392,57 @@ def pallas_knn_candidates(
     *,
     block_q: int = BLOCK_Q,
     tile_n: int = TILE_N,
-    compute_dtype=jnp.bfloat16,
+    precision: str = "bf16x3",
     interpret: Optional[bool] = None,
+    compute_dtype=None,  # accepted for API compat; the kernel is f32-only
 ) -> jax.Array:
-    """[Q, m] coarse candidate indices: fused bin-min kernel + one small
-    lexicographic top-m over the surviving candidates.
-
-    Plug into ops.certified.knn_search_certified as ``candidate_fn`` for
-    guaranteed-exact results at kernel speed.  A bin holds BIN_W=128 db
-    rows and emits one survivor, so two true top-k members in one bin cost
-    a (certified, fallback-corrected) miss — margin and certification make
-    that a speed question, not a correctness one.
-    """
-    if tile_n % BIN_W:
-        raise ValueError(f"tile_n={tile_n} must be a multiple of {BIN_W}")
-    if interpret is None:
-        interpret = not _on_tpu()
+    """[Q, m] coarse candidate indices from the fused kernel — the
+    ``candidate_fn`` plug for ops.certified.knn_search_certified and the
+    kernel-mechanics test surface.  Sentinel (i32 max) marks unfilled
+    slots; ops.refine tolerates them."""
+    del compute_dtype
     n_q = queries.shape[0]
-    d, i = _bin_candidates(
-        queries, db, block_q=block_q, tile_n=tile_n,
-        compute_dtype=jnp.dtype(compute_dtype).name, interpret=interpret,
+    if m >= db.shape[0]:
+        m = max(db.shape[0] - 1, 1)
+    d32, idx, _ = local_certified_candidates(
+        queries, db, m=m, tile_n=tile_n, block_q=block_q,
+        precision=precision, interpret=interpret,
     )
-    n_cand = d.shape[1]
-    if m > n_cand:
-        raise ValueError(
-            f"m={m} exceeds {n_cand} bin candidates; lower tile_n or raise margin"
-        )
-    _, idx = topk_pairs(d[:n_q], i[:n_q], m)
-    return idx
+    return idx[:n_q, :m]
 
 
-def local_bin_topk(
-    q: jax.Array,
-    t: jax.Array,
-    k: int,
-    *,
-    compute_dtype=None,
-    tile_n: int = TILE_N,
-) -> Tuple[jax.Array, jax.Array]:
-    """Shard-local coarse top-k for parallel.sharded's "pallas" selector:
-    (scores [Q, k], local indices [Q, k]).
+def kernel_tolerance(
+    queries_np: np.ndarray, db_np: np.ndarray,
+    *, db_norm_max: Optional[float] = None, precision: str = "bf16x3",
+    q_norm: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Per-query bound on |kernel score - exact score| — the certificate
+    comparison's slack, by kernel matmul mode.
 
-    Scores are squared L2 minus the per-query ``||q||^2`` constant —
-    rank-consistent across db shards for the same query, so the sharded
-    lexicographic merge composes.  One candidate survives per BIN_W=128
-    rows, so k must not exceed shard_rows/BIN_W; callable inside
-    shard_map (one kernel launch per device).
+    - "highest": 2x ops.certified.certification_tolerance — the kernel's
+      tn - 2*qt pipeline has two f32 reduction trees where the count pass
+      has one fused expansion.
+    - "bf16x3": the dropped ql.tl term and the low-part rounding are each
+      <= 2^-17 (||q||^2 + max||t||^2)/2; 2^-14 gives ~8x headroom (and
+      subsumes the f32 accumulation term).
     """
-    if compute_dtype is None:
-        compute_dtype = jnp.bfloat16
-    eff_tile = min(tile_n, max(BIN_W, -(-t.shape[0] // BIN_W) * BIN_W))
-    d, i = _bin_candidates(
-        q, t, block_q=min(BLOCK_Q, max(8, q.shape[0])), tile_n=eff_tile,
-        compute_dtype=jnp.dtype(compute_dtype).name, interpret=not _on_tpu(),
+    from knn_tpu.ops.certified import certification_tolerance
+
+    if q_norm is None:
+        q_norm = (queries_np.astype(np.float64) ** 2).sum(-1)
+    if db_norm_max is None:
+        db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
+    base = 2.0 * certification_tolerance(
+        queries_np, db_np, db_norm_max=db_norm_max, q_norm=q_norm
     )
-    n_cand = d.shape[1]
-    if k > n_cand:
-        raise ValueError(
-            f"pallas selector: k={k} exceeds {n_cand} bins "
-            f"(shard rows / {BIN_W}); use the exact or approx selector"
-        )
-    return topk_pairs(d[: q.shape[0]], i[: q.shape[0]], k)
+    if precision == "bf16x3":
+        return np.maximum(base, 2.0 ** -14 * (q_norm + db_norm_max))
+    if precision == "highest":
+        return base
+    raise ValueError(
+        f"precision {precision!r} has no certified tolerance model; "
+        f"use 'bf16x3' or 'highest'"
+    )
 
 
 def knn_search_pallas(
@@ -217,15 +452,58 @@ def knn_search_pallas(
     *,
     margin: int = 28,
     tile_n: int = TILE_N,
-    compute_dtype=jnp.bfloat16,
-):
-    """Certified-exact KNN with the Pallas kernel as the coarse pass:
-    (dists_f64 [Q, k], idx [Q, k], stats).  See ops.certified."""
-    from knn_tpu.ops.certified import knn_search_certified
+    precision: str = "bf16x3",
+) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Certified-exact KNN in ONE database pass on a single-device mesh:
+    fused kernel coarse select -> device rank -> exclusion-bound
+    certificate -> float64 escalation only for ambiguous/uncertified
+    queries.  Returns (dists [Q, k] float64 array, idx [Q, k], stats):
+    indices are the exact lexicographic top-k; distance VALUES are device
+    f32 direct-difference (relative error < RANK_SLACK) except near-tied
+    or repaired entries, which are float64-exact.  Thin wrapper over
+    ShardedKNN.search_certified(selector="pallas") so single-device
+    and sharded paths share ONE certificate implementation.
 
-    return knn_search_certified(
-        queries, db, k, margin=margin,
-        candidate_fn=functools.partial(
-            pallas_knn_candidates, tile_n=tile_n, compute_dtype=compute_dtype
-        ),
+    Convenience/test surface: every call places the database on the mesh
+    afresh.  Repeated searches against the same database should construct
+    ``ShardedKNN`` once and call ``search_certified`` on it."""
+    from knn_tpu.parallel.mesh import make_mesh
+    from knn_tpu.parallel.sharded import ShardedKNN
+
+    db_np = np.asarray(db, dtype=np.float32)
+    prog = ShardedKNN(
+        db_np, mesh=make_mesh(1, 1, devices=jax.devices()[:1]), k=k
     )
+    return prog.search_certified(
+        np.asarray(queries, dtype=np.float32), margin=margin,
+        selector="pallas", tile_n=tile_n, precision=precision,
+    )
+
+
+def local_bin_topk(
+    q: jax.Array,
+    t: jax.Array,
+    k: int,
+    *,
+    tile_n: int = TILE_N,
+    block_q: int = BLOCK_Q,
+    precision: str = "highest",
+    compute_dtype=None,  # accepted for API compat; the kernel is f32-only
+) -> Tuple[jax.Array, jax.Array]:
+    """Shard-local coarse top-k for parallel.sharded's "pallas" selector:
+    (scores [Q, k], local indices [Q, k]), lexicographically merged so the
+    sharded ring/allgather composition stays deterministic.  Callable
+    inside shard_map (one kernel launch per device)."""
+    del compute_dtype
+    eff_tile = min(tile_n, max(BIN_W, -(-t.shape[0] // BIN_W) * BIN_W))
+    cd, ci, _ = _bin_candidates(
+        q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
+        precision=precision, interpret=not _on_tpu(),
+    )
+    n_cand = cd.shape[1]
+    if k > n_cand:
+        raise ValueError(
+            f"pallas selector: k={k} exceeds {n_cand} bin survivors; "
+            f"use the exact or approx selector"
+        )
+    return topk_pairs(cd[: q.shape[0]], ci[: q.shape[0]], k)

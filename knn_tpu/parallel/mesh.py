@@ -60,11 +60,18 @@ def default_mesh(db_shards: int = 1) -> Mesh:
     return make_mesh(None, db_shards)
 
 
-def pad_to_multiple(x, multiple: int, axis: int = 0) -> Tuple[jax.Array, int]:
-    """Zero-pad ``x`` along ``axis`` up to the next multiple.
+def pad_to_multiple(
+    x, multiple: int, axis: int = 0, *, fill: float = 0.0
+) -> Tuple[jax.Array, int]:
+    """Pad ``x`` along ``axis`` up to the next multiple with ``fill``.
 
     Returns (padded, original_size).  Replaces the reference's divisibility
     `MPI_Abort` (knn_mpi.cpp:127-129): any size works on any mesh.
+
+    Every selection path masks pad rows by index, so ``fill`` never affects
+    results — but the Pallas kernel's exclusion-bound certificate
+    (ops.pallas_knn) is *faster* when pad rows score far away, so database
+    padding passes a huge fill (see ``ShardedKNN``).
 
     NumPy inputs are padded **on host** so a later sharded ``device_put``
     streams each shard straight to its device — the full array never
@@ -77,7 +84,7 @@ def pad_to_multiple(x, multiple: int, axis: int = 0) -> Tuple[jax.Array, int]:
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, padded - n)
     if isinstance(x, np.ndarray):
-        return np.pad(x, widths), n
+        return np.pad(x, widths, constant_values=fill), n
     import jax.numpy as jnp
 
-    return jnp.pad(x, widths), n
+    return jnp.pad(x, widths, constant_values=fill), n

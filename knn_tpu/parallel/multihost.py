@@ -72,7 +72,9 @@ def shard_across_hosts(
     along ``axis_name`` — the multi-host ``MPI_Scatter`` (knn_mpi.cpp:
     226-227) with no root: every host contributes the rows it already has,
     concatenated in process order.  Row counts must be equal across hosts
-    (pad with :func:`knn_tpu.parallel.mesh.pad_to_multiple` first, and pass
+    (pad with :func:`knn_tpu.parallel.mesh.pad_to_multiple` first — prefer
+    ``fill=ops.pallas_knn.PAD_VAL`` so the pallas certificate's exclusion
+    bound stays sharp; zero fill is correct but costs fallbacks — and pass
     the true pre-pad row count to ``ShardedKNN(..., n_train=...)`` so pad
     rows stay masked); the global row count is
     ``local_rows.shape[0] * process_count``.

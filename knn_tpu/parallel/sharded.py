@@ -227,7 +227,12 @@ class ShardedKNN:
                 train = np.asarray(train)  # host padding streams shards on placement
             # host copy (unpadded) for certified-path float64 refinement
             self._train_host = train if isinstance(train, np.ndarray) else None
-            tp, n_train = pad_to_multiple(train, db_shards)
+            # pad rows with a huge fill: every selector also masks them by
+            # index, but the pallas kernel's exclusion bound stays sharp
+            # only if pad rows score far away (ops.pallas_knn.PAD_VAL)
+            from knn_tpu.ops.pallas_knn import PAD_VAL
+
+            tp, n_train = pad_to_multiple(train, db_shards, fill=PAD_VAL)
         shard_rows = tp.shape[0] // db_shards
         if k > shard_rows:
             raise ValueError(
@@ -313,30 +318,40 @@ class ShardedKNN:
 
     def search_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
-        batch_size: Optional[int] = None,
+        batch_size: Optional[int] = None, tile_n: Optional[int] = None,
+        precision: str = "highest",
     ):
-        """Exact lexicographic top-k via the certified pipeline, sharded:
-        coarse top-(k+margin) with a fast selector, float64 host refine,
-        distributed count-below certificate (psum over the db axis), exact
-        fallback for flagged queries.  Returns (dists_f64, idx, stats).
-        L2 only (the certificate threshold is a squared-L2 bound).
+        """Exact lexicographic top-k via the certified pipeline, sharded.
+        Returns (dists_f64, idx, stats).  L2 only (the certificate is a
+        squared-L2 bound).  Two certificate strategies by ``selector``:
+
+        - ``"approx"`` / ``"exact"``: coarse top-(k+margin), float64 host
+          refine, then a distributed count-below pass (psum over the db
+          axis) proves no neighbor was missed — two database passes.
+        - ``"pallas"``: the fused kernel's exclusion bound IS the
+          certificate (ops.pallas_knn) — ONE database pass; ``tile_n`` and
+          ``precision`` tune the kernel.
+
+        Queries failing certification rerun exactly either way; the
+        returned INDICES are the exact lexicographic top-k regardless of
+        selector.  Distances: the counted selectors return float64-exact
+        values (unconditional host refine); the pallas selector returns
+        device f32 direct-difference values (relative error <
+        ops.pallas_knn.RANK_SLACK = 2^-18) except for near-tied or
+        repaired entries, which are float64-exact — the cost of skipping
+        the host refine that would otherwise cap throughput at ~4k q/s.
 
         ``batch_size`` streams the queries in fixed-size batches with the
         device stages pipelined against the host stages: every batch's
         coarse select is dispatched up front (one compiled shape), so the
-        host refine of batch b overlaps the device work of batches > b,
-        and each batch's certificate count dispatches as soon as its
-        thresholds exist.  None = one batch (all queries at once).
+        host refine of batch b overlaps the device work of batches > b.
+        None = one batch (all queries at once).
         """
         if self.metric not in ("l2", "sql2", "euclidean"):
             raise ValueError("search_certified supports the l2 metric only")
         if selector not in SELECTORS:
             raise ValueError(f"unknown selector {selector!r}; expected {SELECTORS}")
-        from knn_tpu.ops.certified import (
-            certification_tolerance,
-            repair_uncertified,
-        )
-        from knn_tpu.ops.refine import refine_exact
+        from knn_tpu.ops.certified import repair_uncertified
 
         q_np = np.asarray(queries, dtype=np.float32)
         n_q = q_np.shape[0]
@@ -345,16 +360,6 @@ class ShardedKNN:
         # coarse/fallback programs select from (k itself fits: __init__
         # checks k <= shard_rows)
         m = min(self.k + margin, self.n_train, shard_rows)
-        if selector == "pallas":
-            # one candidate survives per 128-row bin, capping the margin
-            from knn_tpu.ops.pallas_knn import BIN_W
-
-            m = min(m, max(self.k, shard_rows // BIN_W))
-        coarse = _knn_program(
-            self.mesh, m, self.metric, self.merge, self.n_train,
-            self.train_tile, self._dtype_key, selector,
-        )
-        count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
         db_np = self._host_train()
 
         if batch_size is not None and batch_size < 1:
@@ -371,6 +376,65 @@ class ShardedKNN:
                 chunk = np.pad(chunk, ((0, pad), (0, 0)))
             batches.append((lo, chunk, pad))
 
+        d = np.empty((n_q, self.k))
+        i = np.empty((n_q, self.k), dtype=np.int64)
+
+        if selector == "pallas":
+            bad, n_corrected = self._certify_pallas(
+                batches, bs, m, d, i, q_np, db_np, db_norm_max,
+                tile_n=tile_n, precision=precision,
+            )
+        else:
+            bad = self._certify_counted(
+                batches, bs, m, d, i, q_np, db_np, db_norm_max, selector
+            )
+
+        def _select(qb, widen):
+            # widened exact-selector re-select (bounded by the per-shard
+            # rows the SPMD select can fetch); the returned f32 scores
+            # carry the re-certification exclusion value, so the select
+            # must run in f32 (dtype_key None) even when the main path is
+            # bf16 — certification_tolerance only covers f32 error
+            exact = _knn_program(
+                self.mesh, widen, self.metric, self.merge, self.n_train,
+                self.train_tile, None, "exact",
+            )
+            bq, _ = self._place_queries(qb)
+            fs, fi = exact(bq, self._tp)
+            n_b = qb.shape[0]
+            return np.asarray(fs)[:n_b], np.asarray(fi)[:n_b]
+
+        repair = repair_uncertified(
+            d, i, self.k, m, bad, q_np, db_np,
+            select_fn=_select,
+            max_widen=min(self.n_train, shard_rows),
+            db_norm_max=db_norm_max,
+        )
+        stats = {
+            "fallback_queries": int(bad.size),
+            "certified": n_q - int(bad.size),
+            **repair,
+        }
+        if selector == "pallas":
+            stats["rank_corrected_queries"] = n_corrected
+        return d, i, stats
+
+    def _certify_counted(
+        self, batches, bs, m, d, i, q_np, db_np, db_norm_max, selector
+    ):
+        """Two-pass certificate: coarse select + refine, then the
+        distributed count-below program proves completeness.  Returns the
+        flagged query indices."""
+        from knn_tpu.ops.certified import certification_tolerance
+        from knn_tpu.ops.refine import refine_exact
+
+        n_q = q_np.shape[0]
+        coarse = _knn_program(
+            self.mesh, m, self.metric, self.merge, self.n_train,
+            self.train_tile, self._dtype_key, selector,
+        )
+        count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
+
         # stage 1: dispatch every batch's coarse select (async on device)
         coarse_out = []
         for lo, chunk, pad in batches:
@@ -379,8 +443,6 @@ class ShardedKNN:
 
         # stage 2: per batch — sync its candidates, float64 host refine
         # (overlapping later batches' device work), dispatch its count
-        d = np.empty((n_q, self.k))
-        i = np.empty((n_q, self.k), dtype=np.int64)
         count_out = []
         for (lo, chunk, pad), (qp, (_, ci)) in zip(batches, coarse_out):
             take = bs - pad
@@ -396,48 +458,98 @@ class ShardedKNN:
                 (lo, take, count_fn(qp, self._tp, shard(thr_p, self.mesh, QUERY_AXIS)))
             )
 
-        # stage 3: collect certificates, repair all flagged queries at once
+        # stage 3: collect certificates
         counts = np.empty(n_q, dtype=np.int64)
         for lo, take, c in count_out:
             counts[lo : lo + take] = np.asarray(c)[:take]
+        return np.flatnonzero(counts > self.k)
 
-        bad = np.flatnonzero(counts > self.k)
-
-        def _select(qb, widen):
-            # widened exact-selector re-select (bounded by the per-shard
-            # rows the SPMD select can fetch)
-            exact = _knn_program(
-                self.mesh, widen, self.metric, self.merge, self.n_train,
-                self.train_tile, self._dtype_key, "exact",
-            )
-            bq, _ = self._place_queries(qb)
-            return np.asarray(exact(bq, self._tp)[1])[: qb.shape[0]]
-
-        def _count(qb, thr):
-            bq, _ = self._place_queries(qb)
-            thr_p = np.full(bq.shape[0], -np.inf, dtype=np.float32)
-            thr_p[: qb.shape[0]] = thr
-            return np.asarray(
-                count_fn(bq, self._tp, shard(thr_p, self.mesh, QUERY_AXIS))
-            )[: qb.shape[0]]
-
-        host_exact = repair_uncertified(
-            d, i, self.k, m, bad, q_np, db_np,
-            select_fn=_select, count_fn=_count,
-            max_widen=min(self.n_train, shard_rows),
-            db_norm_max=db_norm_max,
+    def _certify_pallas(
+        self, batches, bs, m, d, i, q_np, db_np, db_norm_max, *,
+        tile_n, precision,
+    ):
+        """One-pass certificate: the fused kernel's exclusion bound lb
+        certifies each query directly (s_k + tol < lb proves no point
+        outside the candidate set can beat the k-th neighbor), and the
+        device rank stage's direct-difference f32 ordering stands in for
+        the float64 host refine — queries whose adjacent candidate gaps
+        fall inside the f32 error band (RANK_SLACK) escalate to the exact
+        host refine instead.  On >1 db shard a second check covers
+        merge-dropped candidates via the (m+1)-th merged distance.
+        Returns (flagged query indices, rank-corrected query count)."""
+        from knn_tpu.ops.pallas_knn import (
+            BIN_W,
+            RANK_SLACK,
+            TILE_N,
+            kernel_tolerance,
         )
-        stats = {
-            "fallback_queries": int(bad.size),
-            "certified": n_q - int(bad.size),
-        }
-        if host_exact:
-            stats["host_exact_queries"] = host_exact
-        return d, i, stats
+        from knn_tpu.ops.refine import rank_correct
+
+        k = self.k
+        # cap m at the kernel's per-shard candidate width minus the one
+        # extra slot the exclusion value needs (mirrors the geometry in
+        # ops.pallas_knn.local_certified_candidates)
+        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        eff_tile = min(tile_n or TILE_N,
+                       max(BIN_W, -(-shard_rows // BIN_W) * BIN_W))
+        m = min(m, -(-shard_rows // eff_tile) * 128 - 2)
+        if m <= k:
+            raise ValueError(
+                f"pallas selector: margin headroom m={m} <= k={k} on "
+                f"{shard_rows}-row shards; lower tile_n or use selector='approx'"
+            )
+        db_shards = self.mesh.shape[DB_AXIS]
+        prog = _pallas_certified_program(
+            self.mesh, m, self.merge, tile_n, precision,
+            n_train=self.n_train,
+        )
+
+        # stage 1: dispatch every batch (async on device)
+        outs = []
+        for lo, chunk, pad in batches:
+            qp, _ = self._place_queries(chunk)
+            outs.append(prog(qp, self._tp))
+
+        # stage 2: per batch — sync candidates + bound; targeted float64
+        # correction of near-tied pairs; certify against lb
+        q_norm = (q_np.astype(np.float64) ** 2).sum(-1)
+        tol = kernel_tolerance(
+            q_np, db_np, db_norm_max=db_norm_max, precision=precision,
+            q_norm=q_norm,
+        )
+        bad_mask = np.zeros(q_np.shape[0], dtype=bool)
+        n_corrected = 0
+        for (lo, chunk, pad), (d32, gi, lb) in zip(batches, outs):
+            take = bs - pad
+            d32 = np.asarray(d32)[:take].astype(np.float64)
+            gi = np.asarray(gi)[:take]
+            lb = np.asarray(lb)[:take].astype(np.float64)
+
+            dc, ic, n_c = rank_correct(
+                d32, gi, k, q_np[lo : lo + take], db_np, RANK_SLACK
+            )
+            n_corrected += n_c
+            d[lo : lo + take] = dc
+            i[lo : lo + take] = ic
+
+            # certificate: d_k carries the f32 rank slack (corrected
+            # entries are exact, but slack at this scale is negligible
+            # next to the kernel tolerance, so apply it uniformly)
+            d_k = dc[:, k - 1]
+            s_k = d_k - q_norm[lo : lo + take]
+            bad = s_k + RANK_SLACK * d_k + tol[lo : lo + take] >= lb
+            if db_shards > 1:
+                # merge-dropped candidates have direct-diff f32 distance
+                # >= the (m+1)-th kept; require true-distance clearance
+                v_excl = d32[:, m] * (1.0 - RANK_SLACK)
+                bad |= d_k + RANK_SLACK * d_k >= v_excl
+            bad_mask[lo : lo + take] = bad
+        return np.flatnonzero(bad_mask), n_corrected
 
     def predict_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
-        batch_size: Optional[int] = None,
+        batch_size: Optional[int] = None, tile_n: Optional[int] = None,
+        precision: str = "highest",
     ):
         """Certified-exact classification: exact neighbor sets from
         :meth:`search_certified`, then the reference vote (ops.vote).
@@ -445,7 +557,8 @@ class ShardedKNN:
         if self._labels is None:
             raise RuntimeError("ShardedKNN built without labels; predict unavailable")
         _, idx, stats = self.search_certified(
-            queries, margin=margin, selector=selector, batch_size=batch_size
+            queries, margin=margin, selector=selector, batch_size=batch_size,
+            tile_n=tile_n, precision=precision,
         )
         labels_host = np.asarray(self._labels)
         votes = majority_vote(jnp.asarray(labels_host[idx]), self.num_classes)
@@ -544,6 +657,59 @@ def sharded_knn_predict(
         labels=train_labels, num_classes=num_classes,
     )
     return prog.predict(queries)
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_certified_program(
+    mesh: Mesh, m: int, merge: str, tile_n: Optional[int], precision: str,
+    n_train: Optional[int] = None,
+):
+    """ONE-pass sharded self-certifying coarse select + device rank
+    (ops.pallas_knn.local_certified_candidates per shard): candidates
+    arrive as direct-difference f32 distances already in lexicographic
+    order, merged across the db axis (ring/allgather as usual) while the
+    kernel-space exclusion bounds pmin.  Returns (d32 [Q, m+1], global idx
+    [Q, m+1], lb [Q]): every db row outside the returned candidates has
+    kernel score >= lb, OR was merge-dropped and has direct-difference
+    distance >= d32[:, m] — the two-part certificate _certify_pallas
+    checks.  No count-below pass, no unconditional host refine."""
+    from knn_tpu.ops.pallas_knn import TILE_N, local_certified_candidates
+
+    db_shards = mesh.shape[DB_AXIS]
+    eff_tile = tile_n or TILE_N
+
+    def spmd(q, t):
+        d32, li, lb = local_certified_candidates(
+            q, t, m, tile_n=eff_tile, precision=precision
+        )
+        db_idx = lax.axis_index(DB_AXIS)
+        gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,
+                       li + db_idx * t.shape[0])
+        if n_train is not None:
+            # pre-placed databases may be zero-padded by the caller (the
+            # multihost contract); rows past n_train are padding, and a
+            # zero pad row sits at the origin — mask by GLOBAL index so
+            # it can never be returned as a neighbor
+            pad = gi >= n_train
+            gi = jnp.where(pad, _INT_SENTINEL, gi)
+            d32 = jnp.where(pad, jnp.inf, d32)
+        if db_shards > 1:
+            if merge == "ring":
+                d32, gi = _ring_merge(d32, gi, m + 1, DB_AXIS, db_shards)
+            else:
+                d32, gi = _allgather_merge(d32, gi, m + 1, DB_AXIS)
+            lb = lax.pmin(lb, axis_name=DB_AXIS)
+        return d32, gi, lb
+
+    return jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS)),
+            out_specs=(P(QUERY_AXIS), P(QUERY_AXIS), P(QUERY_AXIS)),
+            check_vma=False,
+        )
+    )
 
 
 @functools.lru_cache(maxsize=32)

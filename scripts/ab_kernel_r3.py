@@ -1,0 +1,187 @@
+"""A/B kernel cost attribution on the real TPU (scratch, round 3).
+
+Variants isolate: matmul+pipeline floor, binning cost, argmin cost,
+survivor count, matmul precision, and the final-select strategy
+(full top_k vs approx_max_k + exact masked-min exclusion value).
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_N, BLOCK_Q, BIN_W, DIM = 8192, 64, 128, 128
+N, Q = 1_000_000, 512
+NB = TILE_N // BIN_W
+
+rng = np.random.default_rng(0)
+db = (rng.random((N, DIM)) * 128).astype(np.float32)
+qs = (rng.random((4096, DIM)) * 128).astype(np.float32)
+dbj = jnp.asarray(np.pad(db, ((0, 8192 * 123 - N), (0, 0)),
+                         constant_values=1.5e17))
+
+
+def kern(q_ref, t_ref, d_ref, i_ref, b_ref, *, mode, survivors=2,
+         precision=lax.Precision.HIGHEST, mm="f32"):
+    ti = pl.program_id(1)
+    q = q_ref[:]
+    t = t_ref[:]
+    if mm == "bf16x3":
+        qh = q.astype(jnp.bfloat16)
+        th = t.astype(jnp.bfloat16)
+        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        tl = (t - th.astype(jnp.float32)).astype(jnp.bfloat16)
+        dn = (((1,), (1,)), ((), ()))
+        qt = (lax.dot_general(qh, th, dn, preferred_element_type=jnp.float32)
+              + lax.dot_general(qh, tl, dn, preferred_element_type=jnp.float32)
+              + lax.dot_general(ql, th, dn, preferred_element_type=jnp.float32))
+        tn = (lax.dot_general(jnp.ones((8, DIM), jnp.bfloat16), th * th, dn,
+                              preferred_element_type=jnp.float32)
+              + 2.0 * lax.dot_general(jnp.ones((8, DIM), jnp.bfloat16), th * tl,
+                                      dn, preferred_element_type=jnp.float32))
+    else:
+        dn = (((1,), (1,)), ((), ()))
+        qt = lax.dot_general(q, t, dn, preferred_element_type=jnp.float32,
+                             precision=precision)
+        tn = lax.dot_general(jnp.ones((8, DIM), jnp.float32), t * t, dn,
+                             preferred_element_type=jnp.float32,
+                             precision=precision)
+    s = tn[0:1, :] - 2.0 * qt
+    bq = s.shape[0]
+    if mode == "matmul_only":
+        d_ref[:] = s[:, :128]
+        i_ref[:] = jnp.zeros((bq, 128), jnp.int32)
+        b_ref[:] = s[:, :128]
+        return
+    d3 = s.reshape(bq, NB, BIN_W)
+    lane = lax.broadcasted_iota(jnp.int32, d3.shape, 2)
+    base = ti * TILE_N + lax.broadcasted_iota(jnp.int32, (bq, NB), 1) * BIN_W
+    ds, is_ = [], []
+    work = d3
+    for j in range(survivors):
+        mj = jnp.min(work, axis=-1)
+        if mode == "min_only":
+            aj = jnp.zeros_like(mj, dtype=jnp.int32)
+        else:
+            aj = jnp.argmin(work, axis=-1).astype(jnp.int32)
+        ds.append(mj)
+        is_.append(base + aj)
+        if j + 1 < survivors or mode == "full":
+            if mode == "min_only":
+                work = jnp.where(d3 == mj[:, :, None], jnp.inf, work)
+            else:
+                work = jnp.where(lane == aj[:, :, None], jnp.inf, work)
+    bound = jnp.min(work, axis=-1) if mode == "full" else ds[-1]
+    cd = jnp.concatenate(ds, axis=-1)
+    ci = jnp.concatenate(is_, axis=-1)
+    pad = 128 - survivors * NB
+    if pad:
+        cd = jnp.concatenate([cd, jnp.full((bq, pad), jnp.inf, jnp.float32)], -1)
+        ci = jnp.concatenate([ci, jnp.full((bq, pad), 2**31 - 1, jnp.int32)], -1)
+    d_ref[:] = cd
+    i_ref[:] = ci
+    bp = 128 - NB
+    bnd = jnp.concatenate([bound, jnp.full((bq, bp), jnp.inf, jnp.float32)], -1) if bp else bound
+
+    @pl.when(ti == 0)
+    def _():
+        b_ref[:] = bnd
+
+    @pl.when(ti > 0)
+    def _():
+        b_ref[:] = jnp.minimum(b_ref[:], bnd)
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "survivors", "prec", "mm"))
+def launch(q, t, *, mode, survivors=2, prec="highest", mm="f32"):
+    precision = {"highest": lax.Precision.HIGHEST,
+                 "default": lax.Precision.DEFAULT}[prec]
+    k = functools.partial(kern, mode=mode, survivors=survivors,
+                          precision=precision, mm=mm)
+    n_tiles = t.shape[0] // TILE_N
+    return pl.pallas_call(
+        k,
+        grid=(q.shape[0] // BLOCK_Q, n_tiles),
+        in_specs=[
+            pl.BlockSpec((BLOCK_Q, DIM), lambda qi, ti: (qi, 0)),
+            pl.BlockSpec((TILE_N, DIM), lambda qi, ti: (ti, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BLOCK_Q, 128), lambda qi, ti: (qi, ti)),
+            pl.BlockSpec((BLOCK_Q, 128), lambda qi, ti: (qi, ti)),
+            pl.BlockSpec((BLOCK_Q, 128), lambda qi, ti: (qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q.shape[0], n_tiles * 128), jnp.float32),
+            jax.ShapeDtypeStruct((q.shape[0], n_tiles * 128), jnp.int32),
+            jax.ShapeDtypeStruct((q.shape[0], 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+    )(q, t)
+
+
+def amort(fn, nb=12):
+    out = fn(0)
+    np.asarray(out[2]).ravel()[:2]
+    t0 = time.perf_counter()
+    outs = [fn(i % 8) for i in range(nb)]
+    np.asarray(outs[-1][2]).ravel()[:2]
+    return (time.perf_counter() - t0) / nb
+
+
+cfgs = [
+    ("full s2 highest", dict(mode="full", survivors=2, prec="highest")),
+    ("matmul_only highest", dict(mode="matmul_only", prec="highest")),
+    ("matmul_only default", dict(mode="matmul_only", prec="default")),
+    ("matmul_only bf16x3", dict(mode="matmul_only", mm="bf16x3")),
+    ("full s2 bf16x3", dict(mode="full", survivors=2, mm="bf16x3")),
+    ("full s1 highest", dict(mode="full", survivors=1, prec="highest")),
+    ("min_only s2 highest", dict(mode="min_only", survivors=2, prec="highest")),
+    ("full s3 highest", dict(mode="full", survivors=3, prec="highest")),
+]
+for name, kw in cfgs:
+    try:
+        dt = amort(lambda i, kw=kw: launch(jnp.asarray(qs[(i % 8) * Q:(i % 8 + 1) * Q]), dbj, **kw))
+        print(f"{name:24s}: {dt*1e3:7.1f} ms/b512", flush=True)
+    except Exception as e:
+        print(f"{name:24s}: FAIL {str(e)[:140]}", flush=True)
+
+# final-select A/B on realistic candidate arrays
+cd = jnp.asarray(rng.random((Q, 123 * 128)).astype(np.float32))
+ci = jnp.asarray(rng.integers(0, N, (Q, 123 * 128)).astype(np.int32))
+
+
+@jax.jit
+def sel_topk(cd, ci):
+    neg, sel = lax.top_k(-cd, 129)
+    return -neg, jnp.take_along_axis(ci, sel, -1)
+
+
+@jax.jit
+def sel_approx(cd, ci):
+    neg, sel = lax.approx_max_k(-cd, 129, recall_target=0.95)
+    idx = jnp.take_along_axis(ci, sel, -1)
+    # exact exclusion value: min over non-selected candidates
+    masked = cd.at[jnp.arange(Q)[:, None], sel].set(jnp.inf)
+    return -neg, idx, jnp.min(masked, axis=-1)
+
+
+def amort2(fn, nb=12):
+    out = fn()
+    np.asarray(out[0]).ravel()[:2]
+    t0 = time.perf_counter()
+    for _ in range(nb):
+        out = fn()
+    np.asarray(out[0]).ravel()[:2]
+    return (time.perf_counter() - t0) / nb
+
+
+print(f"sel top_k(129):        {amort2(lambda: sel_topk(cd, ci))*1e3:7.1f} ms/b512")
+print(f"sel approx+maskmin:    {amort2(lambda: sel_approx(cd, ci))*1e3:7.1f} ms/b512")

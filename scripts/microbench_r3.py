@@ -1,0 +1,128 @@
+"""Round-3 scratch microbenchmarks on the real TPU: where does selection
+time go, and which final-stage selector wins at candidate widths the new
+Pallas kernel will emit.  Not part of the package; results feed design
+decisions only."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+print("devices:", jax.devices(), flush=True)
+
+rng = np.random.default_rng(0)
+Q = 512
+
+
+def timeit(fn, *args, runs=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+# 1. lax.top_k cost vs width (the final-stage candidate select)
+for w in (7872, 15744, 31488, 62592, 131072):
+    d = jnp.asarray(rng.random((Q, w)), dtype=jnp.float32)
+    f = jax.jit(lambda x: lax.top_k(-x, 128))
+    t = timeit(f, d)
+    print(f"top_k      width={w:7d} k=128: {t*1e3:8.2f} ms/batch512", flush=True)
+
+# 2. two-key sort pairs (lexicographic) at the same widths
+for w in (7872, 15744):
+    d = jnp.asarray(rng.random((Q, w)), dtype=jnp.float32)
+    i = jnp.asarray(rng.integers(0, 1 << 20, (Q, w)), dtype=jnp.int32)
+    f = jax.jit(lambda x, y: lax.sort((x, y), dimension=-1, num_keys=2))
+    t = timeit(f, d, i)
+    print(f"sort_pairs width={w:7d}:      {t*1e3:8.2f} ms/batch512", flush=True)
+
+# 3. approx_max_k over the candidate width (second-stage alternative)
+for w in (15744, 62592):
+    d = jnp.asarray(rng.random((Q, w)), dtype=jnp.float32)
+    f = jax.jit(lambda x: lax.approx_max_k(-x, 128, recall_target=0.95))
+    t = timeit(f, d)
+    print(f"approx_mk  width={w:7d} k=128: {t*1e3:8.2f} ms/batch512", flush=True)
+
+# 4. full-db approx_max_k at high recall_target (certified_approx fix probe)
+N, D = 1_000_000, 128
+db = jnp.asarray((rng.random((N, D)) * 128).astype(np.float32))
+q = jnp.asarray((rng.random((Q, D)) * 128).astype(np.float32))
+t32 = db.astype(jnp.float32)
+half = 0.5 * jnp.sum(t32 * t32, axis=-1)[None, :]
+
+
+def mk_approx(rt):
+    @jax.jit
+    def f(qq, dbb, hh):
+        qt = lax.dot_general(qq, dbb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=lax.Precision.HIGHEST)
+        return lax.approx_max_k(qt - hh, 128, recall_target=rt)
+    return f
+
+
+for rt in (0.99, 0.999, 0.9999):
+    t = timeit(mk_approx(rt), q, db, half)
+    print(f"approx full N=1M rt={rt}: {t*1e3:8.2f} ms/batch512 "
+          f"({Q/t:,.0f} q/s coarse-only)", flush=True)
+
+# 5. the bf16 distance matmul alone (the MXU floor)
+qb = q.astype(jnp.bfloat16)
+dbb16 = db.astype(jnp.bfloat16)
+
+
+@jax.jit
+def mm(qq, dd):
+    return lax.dot_general(qq, dd, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+t = timeit(mm, qb, dbb16)
+fl = 2 * Q * N * D
+print(f"bf16 matmul 512x1M@128:   {t*1e3:8.2f} ms/batch512 "
+      f"({fl/t/1e12:.1f} TF/s)", flush=True)
+
+# 5b. bf16 matmul + top_k over the full 1M row (what exact coarse could be)
+@jax.jit
+def mmtk(qq, dd):
+    d = lax.dot_general(qq, dd, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return lax.top_k(d, 128)
+
+
+t = timeit(mmtk, qb, dbb16)
+print(f"bf16 matmul+top_k(1M):    {t*1e3:8.2f} ms/batch512 "
+      f"({Q/t:,.0f} q/s)", flush=True)
+
+# 6. f32 HIGHEST matmul (the certificate count pass floor)
+@jax.jit
+def mmf(qq, dd):
+    return lax.dot_general(qq, dd, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32,
+                           precision=lax.Precision.HIGHEST)
+
+
+t = timeit(mmf, q, db)
+print(f"f32H matmul 512x1M@128:   {t*1e3:8.2f} ms/batch512 "
+      f"({fl/t/1e12:.1f} TF/s)", flush=True)
+
+# 7. count-below style pass (matmul + compare + sum)
+@jax.jit
+def cnt(qq, dd, hh, thr):
+    qt = lax.dot_general(qq, dd, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32,
+                         precision=lax.Precision.HIGHEST)
+    qn = jnp.sum(qq * qq, axis=-1, keepdims=True)
+    d = qn + 2.0 * hh - 2.0 * qt
+    return jnp.sum((d < thr[:, None]).astype(jnp.int32), axis=-1)
+
+
+thr = jnp.full((Q,), 2.0e5, jnp.float32)
+t = timeit(cnt, q, db, half, thr)
+print(f"count_below full pass:    {t*1e3:8.2f} ms/batch512", flush=True)

@@ -102,13 +102,14 @@ def test_host_exact_knn_matches_oracle(data):
 
 
 def test_persistent_certificate_failure_goes_host_exact(rng):
-    # more identical nearest rows than k: count_below always exceeds k, so
-    # the widened fallback re-certification keeps failing and the pipeline
-    # must drop to the unconditional float64 host scan — still exact, with
-    # ties resolved to the lowest indices
+    # more identical nearest rows than the repair's widened selection can
+    # span: the widen-th selected score ties the k-th distance, so the
+    # exclusion-value re-certification keeps failing and the pipeline
+    # must drop to the unconditional float64 host scan — still exact,
+    # with ties resolved to the lowest indices
     db = rng.normal(size=(400, 8)).astype(np.float32) * 20
     q = rng.normal(size=(6, 8)).astype(np.float32)
-    db[50:70] = q[0] + 0.001  # 20 near-identical rows beside query 0
+    db[50:150] = q[0] + 0.001  # 100 identical rows > widen=69 beside q0
     ref_d, ref_i = _oracle(db, q, 3)
     d, i, stats = knn_search_certified(q, db, 3, tile=128, margin=2)
     np.testing.assert_array_equal(i, ref_i)

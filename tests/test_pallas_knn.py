@@ -1,14 +1,23 @@
-"""Pallas fused bin-min kernel tests (interpret mode on CPU).
+"""Pallas fused kernel tests (interpret mode on CPU).
 
-Exactness always comes from the certified pipeline; the kernel-level tests
-pin the candidate mechanics (bin geometry, masking, known-layout recovery).
+The kernel emits top-s-per-bin candidates plus per-bin exclusion bounds;
+exactness always comes from refine + the bound certificate + fallback.
+These tests pin the candidate mechanics (bin geometry, survivors, padding,
+dim chunking), the *soundness of the exclusion bound* — the property the
+whole one-pass certified path rests on — and the end-to-end certified
+result against a float64 oracle.
 """
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from knn_tpu.ops.pallas_knn import BIN_W, knn_search_pallas, pallas_knn_candidates
+from knn_tpu.ops.pallas_knn import (
+    BIN_W,
+    knn_search_pallas,
+    local_certified_candidates,
+    pallas_knn_candidates,
+)
 
 
 def _oracle(db, queries, k):
@@ -17,52 +26,95 @@ def _oracle(db, queries, k):
     return np.take_along_axis(d, idx, axis=-1), idx
 
 
-def test_kernel_recovers_planted_neighbors(rng):
-    # plant the j-th nearest neighbor in bin j — one per bin, so the
-    # bin-min pass must recover ALL of them exactly
-    n_bins, dim = 6, 16
-    db = rng.normal(size=(n_bins * BIN_W, dim)).astype(np.float32) * 100
+def test_kernel_recovers_two_planted_neighbors_per_bin(rng):
+    # plant TWO of the j-th nearest neighbors in bin j: the top-2-per-bin
+    # reduction must recover ALL of them (the round-2 kernel kept one per
+    # bin and lost the second — the dominant fallback cause at k=100)
+    n_bins, dim = 4, 16
+    tile_n = n_bins * BIN_W
+    db = rng.normal(size=(tile_n, dim)).astype(np.float32) * 100
     query = rng.normal(size=(1, dim)).astype(np.float32)
     planted = []
     for b in range(n_bins):
-        idx = b * BIN_W + int(rng.integers(BIN_W))
-        db[idx] = query[0] + (b + 1) * 1e-3  # distance grows with b
-        planted.append(idx)
+        lo, hi = rng.choice(BIN_W, size=2, replace=False)
+        for j, off in enumerate((lo, hi)):
+            idx = b * BIN_W + int(off)
+            db[idx] = query[0] + (2 * b + j + 1) * 1e-3
+            planted.append(idx)
     cand = np.asarray(
-        pallas_knn_candidates(jnp.asarray(query), jnp.asarray(db), n_bins, tile_n=BIN_W)
+        pallas_knn_candidates(
+            jnp.asarray(query), jnp.asarray(db), 2 * n_bins, tile_n=tile_n
+        )
     )
-    # candidate generation is a SET contract (refine re-orders exactly);
-    # bf16 scores may scramble near-tie ordering
-    np.testing.assert_array_equal(np.sort(cand[0]), planted)
+    np.testing.assert_array_equal(np.sort(cand[0]), np.sort(planted))
 
 
 def test_kernel_masks_padding_rows(rng):
-    # db not a multiple of tile_n: zero-padded rows are near an
-    # origin-query and MUST NOT surface as candidates
+    # db not a multiple of tile_n: PAD_VAL rows score astronomically far
+    # from an origin-query and must never surface as candidates
     db = (rng.normal(size=(3 * BIN_W + 17, 8)).astype(np.float32) + 5.0) * 10
     query = np.zeros((1, 8), dtype=np.float32)
     cand = np.asarray(
-        pallas_knn_candidates(jnp.asarray(query), jnp.asarray(db), 4, tile_n=BIN_W)
+        pallas_knn_candidates(jnp.asarray(query), jnp.asarray(db), 8, tile_n=BIN_W)
     )
     assert (cand < db.shape[0]).all()
 
 
-def test_kernel_candidate_recall_on_random_data(rng):
-    # statistical floor: with k << bins, most true neighbors land alone in
-    # their bin; certified pipeline cleans up the rest
-    db = rng.normal(size=(20 * BIN_W, 32)).astype(np.float32)
-    queries = rng.normal(size=(16, 32)).astype(np.float32)
-    _, true_idx = _oracle(db, queries, 5)
+def test_dim_chunking_matches_unchunked_scores(rng):
+    # dim=300 spans 3 chunks (pad to 384); candidate sets must match the
+    # oracle's top-k exactly on well-separated data
+    db = rng.normal(size=(2 * BIN_W, 300)).astype(np.float32)
+    queries = rng.normal(size=(9, 300)).astype(np.float32)
+    _, true_idx = _oracle(db, queries, 3)
     cand = np.asarray(
-        pallas_knn_candidates(
-            jnp.asarray(queries), jnp.asarray(db), 20, tile_n=2 * BIN_W,
-            compute_dtype=jnp.float32,
+        pallas_knn_candidates(jnp.asarray(queries), jnp.asarray(db), 16,
+                              tile_n=2 * BIN_W)
+    )
+    for c, t in zip(cand, true_idx):
+        assert set(t.tolist()) <= set(c.tolist())
+
+
+@pytest.mark.parametrize("precision", ["highest", "bf16x3"])
+def test_exclusion_bound_is_sound(rng, precision):
+    # THE property the one-pass certificate rests on: every db point
+    # outside the candidate set must have kernel-space score >= lb
+    # (within the precision mode's tolerance), and the returned d32 must
+    # be the candidates' true distances to f32 accuracy
+    db = rng.normal(size=(5 * BIN_W + 60, 24)).astype(np.float32) * 10
+    queries = rng.normal(size=(7, 24)).astype(np.float32) * 10
+    m = 13
+    d32, idx, lb = local_certified_candidates(
+        jnp.asarray(queries), jnp.asarray(db), m=m, block_q=8,
+        tile_n=2 * BIN_W, precision=precision, interpret=True,
+    )
+    d32 = np.asarray(d32)[:7]
+    idx, lb = np.asarray(idx)[:7], np.asarray(lb)[:7]
+    q64, db64 = queries.astype(np.float64), db.astype(np.float64)
+    s_true = (db64**2).sum(-1)[None, :] - 2.0 * (q64 @ db64.T)
+    d_true = ((db64[None] - q64[:, None]) ** 2).sum(-1)
+    from knn_tpu.ops.pallas_knn import kernel_tolerance
+
+    tol = kernel_tolerance(queries, db, precision=precision)
+    for qi in range(queries.shape[0]):
+        outside = np.setdiff1d(np.arange(db.shape[0]), idx[qi])
+        assert s_true[qi, outside].min() >= lb[qi] - tol[qi]
+        np.testing.assert_allclose(
+            d32[qi], d_true[qi, idx[qi]], rtol=1e-5, atol=1e-3
         )
+
+
+def test_survivor_cap_pads_output(rng):
+    # tile_n=BIN_W -> 1 bin -> survivors capped at MAX_SURVIVORS=8; the
+    # remaining 120 slots are sentinel-padded, selection still works
+    db = rng.normal(size=(BIN_W, 8)).astype(np.float32)
+    queries = rng.normal(size=(3, 8)).astype(np.float32)
+    _, true_idx = _oracle(db, queries, 2)
+    cand = np.asarray(
+        pallas_knn_candidates(jnp.asarray(queries), jnp.asarray(db), 8,
+                              tile_n=BIN_W)
     )
-    hits = sum(
-        len(set(c.tolist()) & set(t.tolist())) for c, t in zip(cand, true_idx)
-    )
-    assert hits / true_idx.size > 0.8
+    for c, t in zip(cand, true_idx):
+        assert set(t.tolist()) <= set(c[c < db.shape[0]].tolist())
 
 
 def test_pallas_certified_matches_oracle(rng):
@@ -70,10 +122,74 @@ def test_pallas_certified_matches_oracle(rng):
     db[200:250] = db[:50]  # ties
     queries = rng.normal(size=(23, 24)).astype(np.float32) * 20
     ref_d, ref_i = _oracle(db, queries, 9)
-    d, i, stats = knn_search_pallas(queries, db, 9, tile_n=BIN_W, margin=5)
+    d, i, stats = knn_search_pallas(queries, db, 9, tile_n=4 * BIN_W, margin=8)
     np.testing.assert_array_equal(i, ref_i)
-    np.testing.assert_allclose(d, ref_d, rtol=1e-9)
+    # indices are exact; distances are f32-direct unless a query escalated
+    # to the float64 refine (ops.pallas_knn.RANK_SLACK contract)
+    np.testing.assert_allclose(d, ref_d, rtol=5e-5)
     assert stats["certified"] + stats["fallback_queries"] == 23
+    assert (stats["fallback_genuine_misses"]
+            + stats["fallback_false_alarms"]) == stats["fallback_queries"]
+
+
+def test_pallas_certified_survives_adversarial_bins(rng):
+    # cram the ENTIRE true top-k into one bin with k > MAX_SURVIVORS: the
+    # kernel keeps only the bin's top 8, the bound certificate must flag
+    # the loss and the fallback must still return the exact answer
+    dim, k = 12, 10
+    db = rng.normal(size=(4 * BIN_W, dim)).astype(np.float32) * 50
+    query = rng.normal(size=(1, dim)).astype(np.float32)
+    bin_lo = 2 * BIN_W
+    for j in range(k):
+        db[bin_lo + 3 * j] = query[0] + (j + 1) * 1e-3
+    ref_d, ref_i = _oracle(db, query, k)
+    d, i, stats = knn_search_pallas(query, db, k, tile_n=2 * BIN_W, margin=4)
+    np.testing.assert_array_equal(i, ref_i)
+    assert stats["fallback_queries"] >= 1
+    assert stats["fallback_genuine_misses"] >= 1
+
+
+def test_pad_candidates_never_get_finite_distances(rng):
+    # regression (round-3 review): kernel-padding candidate indices in
+    # [rows, padded) used to be clip-gathered onto the LAST REAL row and
+    # emerge with its finite distance, breaking certified exactness when
+    # real survivors were scarce
+    db = rng.normal(size=(132, 8)).astype(np.float32) * 10
+    queries = rng.normal(size=(5, 8)).astype(np.float32) * 10
+    d32, idx, lb = local_certified_candidates(
+        jnp.asarray(queries), jnp.asarray(db), m=20, tile_n=2 * BIN_W,
+        interpret=True,
+    )
+    d32, idx = np.asarray(d32)[:5], np.asarray(idx)[:5]
+    pad = idx >= db.shape[0]
+    assert np.isinf(d32[pad]).all()
+    assert (idx[pad] == 2**31 - 1).all()
+
+
+def test_preplaced_zero_padded_db_masks_pad_rows(rng):
+    # pre-placed arrays follow the multihost contract: caller zero-pads
+    # and passes n_train; a zero pad row sits at the origin and must not
+    # surface from the pallas certified path (round-3 review finding)
+    import jax
+
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+    from knn_tpu.parallel.mesh import pad_to_multiple
+
+    db = (rng.normal(size=(1001, 8)).astype(np.float32) + 4.0) * 10
+    queries = np.zeros((9, 8), dtype=np.float32)  # at the origin, like pads
+    ref_d, ref_i = _oracle(db, queries, 5)
+    mesh = make_mesh(2, 4)
+    padded, n_train = pad_to_multiple(db, 8)  # zero fill
+    placed = jax.device_put(
+        padded,
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("db")),
+    )
+    prog = ShardedKNN(placed, mesh=mesh, k=5, n_train=n_train)
+    prog._train_host = db  # host copy for the certified refine
+    d, i, stats = prog.search_certified(queries, selector="pallas",
+                                        tile_n=2 * BIN_W)
+    assert (i < n_train).all()
+    np.testing.assert_array_equal(i, ref_i)
 
 
 def test_kernel_rejects_bad_geometry(rng):
@@ -81,5 +197,3 @@ def test_kernel_rejects_bad_geometry(rng):
     q = rng.normal(size=(4, 8)).astype(np.float32)
     with pytest.raises(ValueError, match="multiple"):
         pallas_knn_candidates(jnp.asarray(q), jnp.asarray(db), 4, tile_n=100)
-    with pytest.raises(ValueError, match="bin candidates"):
-        pallas_knn_candidates(jnp.asarray(q), jnp.asarray(db), 1000, tile_n=BIN_W)

@@ -1,0 +1,74 @@
+"""rank_correct: targeted float64 repair of a device-ranked (f32
+direct-difference) candidate list — the pallas certified path's stand-in
+for the full host refine.  Property under test: for ANY candidate list
+whose f32 values are within the slack band of the true distances, the
+output must equal refine_exact on the same candidates, bitwise."""
+
+import numpy as np
+import pytest
+
+from knn_tpu.ops.refine import rank_correct, refine_exact
+
+
+def _device_rank(db, queries, m, rel_noise, rng):
+    """Simulate the device stage: true f64 distances + bounded relative
+    noise, sorted by the noisy value with index tie-break."""
+    d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None]) ** 2).sum(-1)
+    noisy = d * (1.0 + rel_noise * (rng.random(d.shape) * 2 - 1))
+    order = np.lexsort((np.broadcast_to(np.arange(d.shape[1]), d.shape), noisy))
+    idx = order[:, :m]
+    return np.take_along_axis(noisy, idx, -1), idx
+
+
+@pytest.mark.parametrize("rel_noise", [0.0, 1e-6, 1.5e-6])
+def test_rank_correct_matches_full_refine(rng, rel_noise):
+    # precondition: slack must cover the two-sided pair error, i.e.
+    # 2 * rel_noise <= slack (the kernel's true error is ~1.2e-6)
+    slack = 2.0 ** -18
+    db = rng.normal(size=(600, 12)).astype(np.float32) * 10
+    db[100:140] = db[:40]  # exact duplicates -> exactly tied distances
+    queries = rng.normal(size=(64, 12)).astype(np.float32) * 10
+    d32, gi = _device_rank(db, queries, 25, rel_noise, rng)
+    d, i, n_c = rank_correct(d32, gi, 9, queries, db, slack)
+    ref_d, ref_i = refine_exact(db, queries, gi, 9)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=max(4 * rel_noise, 1e-12))
+
+
+def test_rank_correct_counts_and_skips_clean_rows(rng):
+    db = rng.normal(size=(500, 8)).astype(np.float32) * 100
+    queries = rng.normal(size=(16, 8)).astype(np.float32) * 100
+    d32, gi = _device_rank(db, queries, 20, 0.0, rng)
+    # well-separated random data: float64-exact inputs, generous spacing
+    d, i, n_c = rank_correct(d32, gi, 5, queries, db, 2.0 ** -18)
+    ref_d, ref_i = refine_exact(db, queries, gi, 5)
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_rank_correct_degenerate_rows_full_refine(rng):
+    # heavy ties across the whole window force the full-refine path
+    db = np.ones((300, 6), dtype=np.float32)
+    db[250:] = 2.0
+    queries = np.zeros((4, 6), dtype=np.float32)
+    d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None]) ** 2).sum(-1)
+    order = np.argsort(d, axis=-1, kind="stable")[:, :30]
+    d32 = np.take_along_axis(d, order, -1)
+    d_out, i_out, n_c = rank_correct(d32, order, 7, queries, db, 2.0 ** -18)
+    ref_d, ref_i = refine_exact(db, queries, order, 7)
+    np.testing.assert_array_equal(i_out, ref_i)
+    np.testing.assert_array_equal(d_out, ref_d)
+    assert n_c == 4  # every row needed repair
+
+
+def test_rank_correct_sentinel_candidates(rng):
+    db = rng.normal(size=(64, 5)).astype(np.float32)
+    queries = rng.normal(size=(3, 5)).astype(np.float32)
+    d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None]) ** 2).sum(-1)
+    order = np.argsort(d, axis=-1, kind="stable")
+    d32 = np.take_along_axis(d, order, -1)
+    # append sentinel (inf, i32max) slots as the kernel pads them
+    d32 = np.concatenate([d32, np.full((3, 8), np.inf)], axis=-1)
+    gi = np.concatenate([order, np.full((3, 8), 2**31 - 1, np.int64)], axis=-1)
+    d_out, i_out, _ = rank_correct(d32, gi, 4, queries, db, 2.0 ** -18)
+    ref_d, ref_i = refine_exact(db, queries, gi, 4)
+    np.testing.assert_array_equal(i_out, ref_i)
