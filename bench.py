@@ -80,9 +80,12 @@ try:
     NQ = _env_int("KNN_BENCH_NQ", 4096)
     BATCH = _env_int("KNN_BENCH_BATCH", 512)  # sweep winner on v5e (2026-07)
     TILE = _env_int("KNN_BENCH_TILE", 131_072)
-    #: 64 queries ~ balances denominator noise against CPU runtime; the JSON
-    #: carries cpu_queries + per-query time so the claim is auditable.
-    CPU_QUERIES = _env_int("KNN_BENCH_CPU_QUERIES", 64)
+    #: 256 queries (VERDICT r2 item 7): ~40 s of CPU once per round buys a
+    #: 4x larger denominator sample; cpu_queries + per-query time stay in
+    #: the JSON so the claim is auditable.
+    CPU_QUERIES = _env_int("KNN_BENCH_CPU_QUERIES", 256)
+    #: pallas-certified kernel matmul mode (ops.pallas_knn.PRECISIONS)
+    PALLAS_PRECISION = os.environ.get("KNN_BENCH_PALLAS_PRECISION", "bf16x3")
     DTYPE = os.environ.get("KNN_BENCH_DTYPE", _cfg["dtype"])
     RUNS = _env_int("KNN_BENCH_RUNS", 5)
     #: Coarse pass fetches K + MARGIN candidates; float64 refinement
@@ -310,7 +313,16 @@ def main() -> None:
 
     def sweep_certified(selector):
         def run(qs):
-            # one pipelined call: all coarse selects dispatch up front, host
+            if selector == "pallas":
+                # ONE device pass + one batch: the fused kernel certifies
+                # itself, and through the dev harness's slow D2H relay a
+                # single large transfer beats pipelined small ones
+                _, i, st = prog.search_certified(
+                    qs, margin=MARGIN, selector=selector, batch_size=None,
+                    precision=PALLAS_PRECISION,
+                )
+                return i, st
+            # counted path: all coarse selects dispatch up front, host
             # refine overlaps later batches' device work (sharded.py)
             _, i, st = prog.search_certified(
                 qs, margin=MARGIN, selector=selector, batch_size=BATCH
@@ -324,9 +336,49 @@ def main() -> None:
         "certified_pallas": sweep_certified("pallas"),
     }
     #: database passes per query: coarse matmul, + the certificate's
-    #: count-below matmul for certified modes (fallback excluded — it is
-    #: rare, per-run stats record it)
-    passes = {"exact": 1, "certified_approx": 2, "certified_pallas": 2}
+    #: count-below matmul for the counted certified mode (fallback
+    #: excluded — it is rare, per-run stats record it).  The pallas
+    #: kernel self-certifies: ONE pass.
+    passes = {"exact": 1, "certified_approx": 2, "certified_pallas": 1}
+
+    def phase_breakdown_pallas():
+        """Where a certified_pallas sweep's wall time goes (VERDICT r2
+        missing item 4): device compute vs device->host transfer vs host
+        rank-correction, measured on the full query set with the already-
+        compiled program.  Also measures the harness's D2H bandwidth —
+        through the dev relay it is the binding resource, NOT the TPU."""
+        from knn_tpu.ops.pallas_knn import RANK_SLACK
+        from knn_tpu.ops.refine import rank_correct
+
+        # the same program+geometry the timed sweep ran (ONE source of
+        # truth: ShardedKNN._pallas_setup)
+        pp, _ = prog._pallas_setup(MARGIN, None, PALLAS_PRECISION)
+        qp, _ = prog._place_queries(queries)
+        out = pp(qp, prog._tp)
+        np.asarray(out[2]).ravel()[:1]  # warm/compiled
+        t0 = time.perf_counter()
+        out = pp(qp, prog._tp)
+        np.asarray(out[2]).ravel()[:1]  # tiny sync: device-only time
+        dev = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        d32 = np.asarray(out[0])
+        gi = np.asarray(out[1])
+        xfer = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        rank_correct(d32[:NQ].astype(np.float64), gi[:NQ], K, queries, db,
+                     RANK_SLACK)
+        host = time.perf_counter() - t0
+        mb = (d32.nbytes + gi.nbytes) / 1e6
+        return {
+            "device_s": round(dev, 4),
+            "device_qps": round(NQ / dev, 1),
+            "d2h_transfer_s": round(xfer, 4),
+            "d2h_mb": round(mb, 2),
+            "d2h_mbps": round(mb / xfer, 1) if xfer > 0 else None,
+            "host_rank_correct_s": round(host, 4),
+            "note": ("sweep wall ~= device + d2h + rank_correct + repair; "
+                     "d2h rides the dev harness's relay, not TPU PCIe"),
+        }
 
     trace_dir = os.environ.get("KNN_BENCH_TRACE")
     results = {}
@@ -337,7 +389,11 @@ def main() -> None:
             if oracle_idx is not None:
                 idx_sub, _ = fn(sub)  # also compiles every program involved
                 entry["recall_at_k"] = recall_at_k(idx_sub, oracle_idx)
-            fn(queries[:BATCH])  # warm the full-batch shape
+            # warm the exact shapes the timed runs use: the pallas mode
+            # runs ONE full-size batch (different program shape than the
+            # BATCH-sized pipeline), so it must warm on the full set or
+            # run 1 silently pays its compile
+            fn(queries if mode == "certified_pallas" else queries[:BATCH])
             times = []
             stats = None
             for _ in range(RUNS):
@@ -367,6 +423,15 @@ def main() -> None:
             })
             if stats is not None:
                 entry["certified_stats"] = stats
+            if mode == "certified_pallas":
+                pb = phase_breakdown_pallas()
+                entry["phase_breakdown"] = pb
+                if peak is not None and pb.get("device_s"):
+                    # MFU of the device phase alone — what the chip does,
+                    # net of the harness's D2H relay
+                    entry["mfu_device"] = round(
+                        flops / pb["device_s"] / peak, 4
+                    )
         except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
             entry["error"] = f"{type(e).__name__}: {e}"
         results[mode] = entry
@@ -402,12 +467,15 @@ def main() -> None:
               selectors=results, backend=backend)
     best = ranked[0]
     qps = results[best]["qps_mean"]
+    # vs_baseline from the SAME rounded fields the JSON carries, so the
+    # artifact is internally reproducible (round-2 advisor finding)
+    cpu_qps_r = round(cpu_qps, 2) if cpu_qps else None
 
     _emit({
         "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
         "value": qps,
         "unit": "queries/s",
-        "vs_baseline": round(qps / cpu_qps, 2) if cpu_qps else None,
+        "vs_baseline": round(qps / cpu_qps_r, 2) if cpu_qps_r else None,
         "mode": best,
         "recall_at_k": results[best].get("recall_at_k"),
         **recall_flag,
@@ -418,7 +486,7 @@ def main() -> None:
         "mfu": results[best]["mfu"],
         "peak_flops_assumed": peak,
         "selectors": results,
-        "cpu_baseline_qps": round(cpu_qps, 2) if cpu_qps else None,
+        "cpu_baseline_qps": cpu_qps_r,
         "cpu_queries": CPU_QUERIES,
         "cpu_per_query_s": round(cpu_per_q_s, 4) if cpu_per_q_s else None,
         "devices": len(mesh.devices.ravel()),

@@ -30,17 +30,24 @@ def knn_regress(
     dists, idx = knn_search_tiled(
         queries, train, k, metric, train_tile=train_tile, compute_dtype=compute_dtype
     )
-    return _weighted_targets(dists, train_targets[idx], weights)
+    return _weighted_targets(dists, train_targets[idx], weights, metric)
 
 
-def _weighted_targets(dists, targets, weights: str):
+def _weighted_targets(dists, targets, weights: str, metric: str = "l2"):
     """Reduce [Q, k] neighbor targets to predictions — the one place the
     uniform/inverse-distance weighting lives (single-device and meshed
-    paths share it)."""
+    paths share it).
+
+    ``weights="distance"`` is conventional 1/d weighting: the search
+    returns SQUARED L2 for ranking speed (the monotone sqrt is dropped,
+    knn_mpi.cpp:48), so the l2 metrics sqrt here first — weighting by
+    squared distance would silently over-discount far neighbors."""
     targets = targets.astype(jnp.float32)  # [Q, k] or [Q, k, out]
     if weights == "uniform":
         return jnp.mean(targets, axis=1)
     if weights == "distance":
+        if metric.lower() in ("l2", "sql2", "euclidean"):
+            dists = jnp.sqrt(jnp.maximum(dists, 0.0))
         w = 1.0 / jnp.maximum(dists, 1e-12)  # [Q, k]
         w = w / jnp.sum(w, axis=1, keepdims=True)
         if targets.ndim == 3:
@@ -106,7 +113,9 @@ class KNNRegressor:
             raise RuntimeError("call fit() first")
         if self._program is not None:
             dists, idx = self._program.search(jnp.asarray(Q))
-            return _weighted_targets(dists, self._targets[idx], self.weights)
+            return _weighted_targets(
+                dists, self._targets[idx], self.weights, self.metric
+            )
         return knn_regress(
             self._train,
             self._targets,

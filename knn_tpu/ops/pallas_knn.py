@@ -403,7 +403,10 @@ def pallas_knn_candidates(
     del compute_dtype
     n_q = queries.shape[0]
     if m >= db.shape[0]:
-        m = max(db.shape[0] - 1, 1)
+        raise ValueError(
+            f"m={m} >= n_db={db.shape[0]}: the kernel needs headroom for "
+            f"its exclusion value; use the exact path for whole-db selects"
+        )
     d32, idx, _ = local_certified_candidates(
         queries, db, m=m, tile_n=tile_n, block_q=block_q,
         precision=precision, interpret=interpret,
@@ -480,30 +483,3 @@ def knn_search_pallas(
     )
 
 
-def local_bin_topk(
-    q: jax.Array,
-    t: jax.Array,
-    k: int,
-    *,
-    tile_n: int = TILE_N,
-    block_q: int = BLOCK_Q,
-    precision: str = "highest",
-    compute_dtype=None,  # accepted for API compat; the kernel is f32-only
-) -> Tuple[jax.Array, jax.Array]:
-    """Shard-local coarse top-k for parallel.sharded's "pallas" selector:
-    (scores [Q, k], local indices [Q, k]), lexicographically merged so the
-    sharded ring/allgather composition stays deterministic.  Callable
-    inside shard_map (one kernel launch per device)."""
-    del compute_dtype
-    eff_tile = min(tile_n, max(BIN_W, -(-t.shape[0] // BIN_W) * BIN_W))
-    cd, ci, _ = _bin_candidates(
-        q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
-        precision=precision, interpret=not _on_tpu(),
-    )
-    n_cand = cd.shape[1]
-    if k > n_cand:
-        raise ValueError(
-            f"pallas selector: k={k} exceeds {n_cand} bin survivors; "
-            f"use the exact or approx selector"
-        )
-    return topk_pairs(cd[: q.shape[0]], ci[: q.shape[0]], k)

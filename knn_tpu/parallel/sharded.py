@@ -83,11 +83,11 @@ def _allgather_merge(d, i, k: int, axis_name: str):
 
 _MERGES = ("allgather", "ring")
 
-#: Local-shard neighbor selectors.  "exact" ranks every row (float32
+#: Certified-path coarse selectors.  "exact" ranks every row (float32
 #: lexicographic top-k); "approx" uses the hardware bin-reduction behind
-#: lax.approx_max_k; "pallas" uses the fused distance+bin-min kernel
-#: (ops.pallas_knn).  The approximate selectors are for the *certified*
-#: path (search_certified), where misses are detected and repaired.
+#: lax.approx_max_k (count-below certificate); "pallas" routes to the
+#: one-pass self-certifying kernel program (_pallas_certified_program) —
+#: it never reaches _local_topk/_knn_program.
 SELECTORS = ("exact", "approx", "pallas")
 
 
@@ -113,10 +113,6 @@ def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector):
         d, i = knn_search_approx(
             q, t, k, compute_dtype=compute_dtype, n_valid=n_local_valid
         )
-    elif selector == "pallas":
-        from knn_tpu.ops.pallas_knn import local_bin_topk
-
-        d, i = local_bin_topk(q, t, k, compute_dtype=compute_dtype)
     else:
         raise ValueError(f"unknown selector {selector!r}; expected one of {SELECTORS}")
     pad = i >= n_local_valid
@@ -464,6 +460,34 @@ class ShardedKNN:
             counts[lo : lo + take] = np.asarray(c)[:take]
         return np.flatnonzero(counts > self.k)
 
+    def _pallas_setup(self, margin: int, tile_n: Optional[int],
+                      precision: str):
+        """(program, m) for the one-pass certified path — the ONE home of
+        the kernel-geometry margin cap, shared by :meth:`_certify_pallas`
+        and bench.py's phase breakdown so they can never measure
+        different programs."""
+        from knn_tpu.ops.pallas_knn import BIN_W, TILE_N
+
+        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        eff_tile = min(tile_n or TILE_N,
+                       max(BIN_W, -(-shard_rows // BIN_W) * BIN_W))
+        # m is bounded by the db, the per-shard rows, and the kernel's
+        # per-shard candidate width minus the two slots the exclusion
+        # value needs (ops.pallas_knn.local_certified_candidates)
+        m = min(self.k + margin, self.n_train, shard_rows,
+                -(-shard_rows // eff_tile) * 128 - 2)
+        if m <= self.k:
+            raise ValueError(
+                f"pallas selector: margin headroom m={m} <= k={self.k} on "
+                f"{shard_rows}-row shards; lower tile_n or use "
+                f"selector='approx'"
+            )
+        prog = _pallas_certified_program(
+            self.mesh, m, self.merge, tile_n, precision,
+            n_train=self.n_train,
+        )
+        return prog, m
+
     def _certify_pallas(
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, *,
         tile_n, precision,
@@ -477,32 +501,12 @@ class ShardedKNN:
         host refine instead.  On >1 db shard a second check covers
         merge-dropped candidates via the (m+1)-th merged distance.
         Returns (flagged query indices, rank-corrected query count)."""
-        from knn_tpu.ops.pallas_knn import (
-            BIN_W,
-            RANK_SLACK,
-            TILE_N,
-            kernel_tolerance,
-        )
+        from knn_tpu.ops.pallas_knn import RANK_SLACK, kernel_tolerance
         from knn_tpu.ops.refine import rank_correct
 
         k = self.k
-        # cap m at the kernel's per-shard candidate width minus the one
-        # extra slot the exclusion value needs (mirrors the geometry in
-        # ops.pallas_knn.local_certified_candidates)
-        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
-        eff_tile = min(tile_n or TILE_N,
-                       max(BIN_W, -(-shard_rows // BIN_W) * BIN_W))
-        m = min(m, -(-shard_rows // eff_tile) * 128 - 2)
-        if m <= k:
-            raise ValueError(
-                f"pallas selector: margin headroom m={m} <= k={k} on "
-                f"{shard_rows}-row shards; lower tile_n or use selector='approx'"
-            )
         db_shards = self.mesh.shape[DB_AXIS]
-        prog = _pallas_certified_program(
-            self.mesh, m, self.merge, tile_n, precision,
-            n_train=self.n_train,
-        )
+        prog, m = self._pallas_setup(m - self.k, tile_n, precision)
 
         # stage 1: dispatch every batch (async on device)
         outs = []

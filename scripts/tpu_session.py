@@ -95,11 +95,14 @@ def pallas_proof():
     qj, dbj = jnp.asarray(q), jnp.asarray(db)
 
     def timeit(name, fn, reps=5):
-        jax.tree_util.tree_leaves(fn())[0].block_until_ready()  # warm/compile
+        # sync by fetching a tiny slice: block_until_ready does NOT block
+        # through the axon relay (measured round 3), so a host fetch is
+        # the only real fence
+        np.asarray(jax.tree_util.tree_leaves(fn())[0]).ravel()[:1]
         t0 = time.time()
         for _ in range(reps):
             r = fn()
-        jax.tree_util.tree_leaves(r)[0].block_until_ready()
+        np.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[:1]
         timings[name] = round((time.time() - t0) / reps, 4)
         log(f"  {name}: {timings[name]}s / {q.shape[0]} queries")
 
@@ -108,6 +111,10 @@ def pallas_proof():
     timeit("approx_topk", lambda: knn_search_approx(qj, dbj, m))
     timeit("pallas_bins", lambda: pallas_knn_candidates(qj, dbj, m,
                                                         interpret=False))
+    from knn_tpu.ops.pallas_knn import local_certified_candidates
+
+    timeit("pallas_certified_coarse",
+           lambda: local_certified_candidates(qj, dbj, m, interpret=False))
     rec = {"pallas_proof": {"recall_refined": pal_recall,
                             "certified_exact": cert_ok,
                             "selector_seconds_per_256q": timings,

@@ -76,3 +76,21 @@ def test_meshed_regressor_matches_single_device(rng):
             .fit(X, y).predict(Q)
         )
         np.testing.assert_allclose(got, ref, rtol=rtol)
+
+
+def test_distance_weights_use_unsquared_l2(rng):
+    # VERDICT r2 weak #6: weights="distance" must weight by 1/d (true L2),
+    # not 1/d^2 — the search returns squared distances for ranking speed
+    import numpy as np
+
+    from knn_tpu.models.regressor import KNNRegressor
+
+    X = np.array([[0.0], [3.0], [9.0]], dtype=np.float32)
+    y = np.array([0.0, 1.0, 2.0], dtype=np.float32)
+    q = np.array([[1.0]], dtype=np.float32)  # d = [1, 2, 8]
+    pred = float(
+        KNNRegressor(k=3, weights="distance").fit(X, y).predict(q)[0]
+    )
+    w = 1.0 / np.array([1.0, 2.0, 8.0])
+    expect = float((w / w.sum() @ y))
+    assert abs(pred - expect) < 1e-6
