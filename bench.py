@@ -492,7 +492,9 @@ def main() -> None:
         "devices": len(mesh.devices.ravel()),
         "device_kind": getattr(dev, "device_kind", str(dev)),
         "backend": backend,
-        "batch": BATCH,
+        # the winning mode's actual batch: the pallas path runs ONE
+        # full-size batch (sweep_certified passes batch_size=None)
+        "batch": NQ if best == "certified_pallas" else BATCH,
         "train_tile": tile,
     })
 

@@ -70,8 +70,9 @@ from knn_tpu.ops.topk import topk_pairs
 
 #: bin width — the lane count; `survivors` candidates + one bound per bin
 BIN_W = 128
-#: query rows per grid cell (VMEM: the [BLOCK_Q, TILE_N] f32 score tile)
-BLOCK_Q = 64
+#: query rows per grid cell (VMEM: the [BLOCK_Q, TILE_N] f32 score tile;
+#: 128 fills the MXU's M dimension — measured best on v5e)
+BLOCK_Q = 128
 #: database rows per grid cell; with BIN_W=128 bins and 128-lane outputs,
 #: survivors = 128 // (TILE_N // BIN_W) = 2 per bin
 TILE_N = 8192
@@ -119,7 +120,7 @@ def _geometry(tile_n: int) -> Tuple[int, int]:
     return n_bins, min(128 // n_bins, MAX_SURVIVORS, BIN_W)
 
 
-def _kernel(q_ref, t_ref, d_ref, i_ref, b_ref, *scratch,
+def _kernel(q_ref, t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch,
             tile_n: int, n_bins: int, survivors: int, nd: int, precision: str):
     ti = pl.program_id(1)
     di = pl.program_id(2)
@@ -141,33 +142,25 @@ def _kernel(q_ref, t_ref, d_ref, i_ref, b_ref, *scratch,
                 else lax.Precision.DEFAULT)
         qt = lax.dot_general(q, t, dn, preferred_element_type=jnp.float32,
                              precision=prec)  # [BQ, T]
-    # db row norms via MXU so they land lane-major directly ([8, T]; row 0
-    # used) — no sublane->lane transpose needed.  Always f32 HIGHEST: the
-    # [8, dim] @ [dim, T] dot is ~1% of the qt matmul's cost.
-    ones = jnp.ones((8, t.shape[1]), jnp.float32)
-    tn = lax.dot_general(
-        ones, t * t, dimension_numbers=dn,
-        preferred_element_type=jnp.float32, precision=lax.Precision.HIGHEST,
-    )
-
+    # db row norms arrive precomputed ([8, T] broadcast, row 0 used): an
+    # XLA f32 reduction once per call instead of a per-cell ones-matmul
+    # (which cost ~12% of the qt matmul as a 6-pass f32 HIGHEST dot)
     if nd == 1:
         # single dim chunk: no scratch allocated, skip the VMEM
         # accumulation round-trip entirely (measured ~16% of kernel time
         # at SIFT shape)
-        _emit_select(ti, qt, tn, d_ref, i_ref, b_ref,
+        _emit_select(ti, qt, tn_ref[:], d_ref, i_ref, b_ref,
                      tile_n=tile_n, n_bins=n_bins, survivors=survivors)
         return
-    qt_ref, tn_ref = scratch
+    qt_ref, = scratch
 
     @pl.when(di == 0)
     def _init():
         qt_ref[:] = qt
-        tn_ref[:] = tn
 
     @pl.when(di > 0)
     def _acc():
         qt_ref[:] += qt
-        tn_ref[:] += tn
 
     @pl.when(di == nd - 1)
     def _select():
@@ -262,6 +255,11 @@ def _bin_candidates(
     n_tiles = db.shape[0] // tile_n
     nd = dim // DIM_CHUNK
     n_bins, survivors = _geometry(tile_n)
+    # full-dim db row norms, f32, broadcast to 8 sublanes so the kernel
+    # reads them as a lane-major [8, tile_n] block
+    tnorm = jnp.broadcast_to(
+        jnp.sum(db * db, axis=-1)[None, :], (8, db.shape[0])
+    )
 
     if precision not in PRECISIONS:
         raise ValueError(f"precision {precision!r} not in {PRECISIONS}")
@@ -286,6 +284,7 @@ def _bin_candidates(
         in_specs=[
             pl.BlockSpec((block_q, DIM_CHUNK), lambda qi, ti, di: (qi, di)),
             pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+            pl.BlockSpec((8, tile_n), lambda qi, ti, di: (0, ti)),
         ],
         out_specs=[
             pl.BlockSpec((block_q, 128), lambda qi, ti, di: (qi, ti)),
@@ -297,16 +296,15 @@ def _bin_candidates(
             jax.ShapeDtypeStruct((qp, n_tiles * 128), jnp.int32),
             jax.ShapeDtypeStruct((qp, 128), jnp.float32),
         ],
-        # the accumulation scratch is only touched when dim spans multiple
-        # chunks; at dim <= 128 (the headline shape) skipping it returns
-        # ~2 MB of VMEM to the pipeline
+        # the qt accumulation scratch is only touched when dim spans
+        # multiple chunks; at dim <= 128 (the headline shape) skipping it
+        # returns VMEM to the pipeline
         scratch_shapes=[] if nd == 1 else [
             pltpu.VMEM((block_q, tile_n), jnp.float32),
-            pltpu.VMEM((8, tile_n), jnp.float32),
         ],
         interpret=interpret,
         **kwargs,
-    )(queries, db)
+    )(queries, db, tnorm)
 
 
 @functools.partial(
@@ -402,16 +400,22 @@ def pallas_knn_candidates(
     slots; ops.refine tolerates them."""
     del compute_dtype
     n_q = queries.shape[0]
-    if m >= db.shape[0]:
-        raise ValueError(
-            f"m={m} >= n_db={db.shape[0]}: the kernel needs headroom for "
-            f"its exclusion value; use the exact path for whole-db selects"
-        )
+    # the kernel needs one exclusion slot, so a whole-db request (m >= n,
+    # e.g. knn_search_certified on a tiny db computing m = min(k+margin,
+    # n)) selects n-1 rows and sentinel-pads the rest — the count
+    # certificate catches the one unexaminable row, keeping composition
+    # exact while honoring the [Q, m] shape contract
+    m_eff = min(m, max(db.shape[0] - 1, 1))
     d32, idx, _ = local_certified_candidates(
-        queries, db, m=m, tile_n=tile_n, block_q=block_q,
+        queries, db, m=m_eff, tile_n=tile_n, block_q=block_q,
         precision=precision, interpret=interpret,
     )
-    return idx[:n_q, :m]
+    idx = idx[:n_q, :m_eff]
+    if m_eff < m:
+        idx = jnp.concatenate(
+            [idx, jnp.full((n_q, m - m_eff), _I32MAX, jnp.int32)], axis=-1
+        )
+    return idx
 
 
 def kernel_tolerance(

@@ -197,3 +197,20 @@ def test_kernel_rejects_bad_geometry(rng):
     q = rng.normal(size=(4, 8)).astype(np.float32)
     with pytest.raises(ValueError, match="multiple"):
         pallas_knn_candidates(jnp.asarray(q), jnp.asarray(db), 4, tile_n=100)
+
+
+def test_candidate_fn_composition_on_tiny_db(rng):
+    # regression (round-3 review): knn_search_certified computes
+    # m = min(k+margin, n); on dbs with n <= k+margin the kernel keeps
+    # n-1 rows + sentinel padding and the count certificate repairs the
+    # one unexaminable row — composition must stay exact
+    from knn_tpu.ops.certified import knn_search_certified
+
+    db = rng.normal(size=(20, 6)).astype(np.float32) * 10
+    queries = rng.normal(size=(7, 6)).astype(np.float32) * 10
+    ref_d, ref_i = _oracle(db, queries, 5)
+    d, i, stats = knn_search_certified(
+        queries, db, 5, candidate_fn=pallas_knn_candidates
+    )
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-9)
