@@ -311,7 +311,7 @@ def main() -> None:
             out.append(refine_exact(db, qs[lo : lo + i.shape[0]], i, K, METRIC)[1])
         return np.concatenate(out), None
 
-    def sweep_certified(selector):
+    def sweep_certified(selector, return_distances=True):
         def run(qs):
             if selector == "pallas":
                 # ONE device pass + one batch: the fused kernel certifies
@@ -320,6 +320,7 @@ def main() -> None:
                 _, i, st = prog.search_certified(
                     qs, margin=MARGIN, selector=selector, batch_size=None,
                     precision=PALLAS_PRECISION,
+                    return_distances=return_distances,
                 )
                 return i, st
             # counted path: all coarse selects dispatch up front, host
@@ -347,28 +348,32 @@ def main() -> None:
         rank-correction, measured on the full query set with the already-
         compiled program.  Also measures the harness's D2H bandwidth —
         through the dev relay it is the binding resource, NOT the TPU."""
-        from knn_tpu.ops.pallas_knn import RANK_SLACK
-        from knn_tpu.ops.refine import rank_correct
+        from knn_tpu.ops.refine import rank_correct_runs
 
         # the same program+geometry the timed sweep ran (ONE source of
         # truth: ShardedKNN._pallas_setup)
         pp, _ = prog._pallas_setup(MARGIN, None, PALLAS_PRECISION)
         qp, _ = prog._place_queries(queries)
-        out = pp(qp, prog._tp)
-        np.asarray(out[2]).ravel()[:1]  # warm/compiled
+        norm_op = np.float32(prog._db_norm_max())
+        out = pp(qp, prog._tp, norm_op)
+        np.asarray(out[3]).ravel()[:1]  # warm/compiled
         t0 = time.perf_counter()
-        out = pp(qp, prog._tp)
-        np.asarray(out[2]).ravel()[:1]  # tiny sync: device-only time
+        out = pp(qp, prog._tp, norm_op)
+        np.asarray(out[3]).ravel()[:1]  # tiny sync: device-only time
         dev = time.perf_counter() - t0
         t0 = time.perf_counter()
-        d32 = np.asarray(out[0])
+        # exactly the sweep's fetch set: indices, tie mask, flags, top-k
+        # distance block — the [Q, m+1] score matrix stays on device
         gi = np.asarray(out[1])
+        tight = np.asarray(out[2])
+        badf = np.asarray(out[3])
+        dk = np.asarray(out[0][:, :K])
         xfer = time.perf_counter() - t0
         t0 = time.perf_counter()
-        rank_correct(d32[:NQ].astype(np.float64), gi[:NQ], K, queries, db,
-                     RANK_SLACK)
+        rank_correct_runs(gi[:NQ], tight[:NQ].astype(bool), K, queries, db,
+                          d32k=dk[:NQ].astype(np.float64))
         host = time.perf_counter() - t0
-        mb = (d32.nbytes + gi.nbytes) / 1e6
+        mb = (gi.nbytes + tight.nbytes + badf.nbytes + dk.nbytes) / 1e6
         return {
             "device_s": round(dev, 4),
             "device_qps": round(NQ / dev, 1),
@@ -432,6 +437,18 @@ def main() -> None:
                     entry["mfu_device"] = round(
                         flops / pb["device_s"] / peak, 4
                     )
+                # label-only consumers (the reference's actual workload:
+                # predicted labels) skip the distance transfer
+                lo_fn = sweep_certified("pallas", return_distances=False)
+                lo_fn(queries)  # warm the distance-free fetch path
+                lo_times = []
+                for _ in range(min(RUNS, 3)):
+                    t0 = time.perf_counter()
+                    lo_fn(queries)
+                    lo_times.append(time.perf_counter() - t0)
+                entry["qps_labels_only"] = round(
+                    NQ / float(np.mean(lo_times)), 2
+                )
         except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
             entry["error"] = f"{type(e).__name__}: {e}"
         results[mode] = entry

@@ -424,14 +424,17 @@ def kernel_tolerance(
     q_norm: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Per-query bound on |kernel score - exact score| — the certificate
-    comparison's slack, by kernel matmul mode.
+    comparison's slack, by kernel matmul mode.  Mirrors the on-device
+    formula in parallel.sharded._pallas_certified_program.
 
-    - "highest": 2x ops.certified.certification_tolerance — the kernel's
-      tn - 2*qt pipeline has two f32 reduction trees where the count pass
-      has one fused expansion.
+    - "highest": 4x ops.certified.certification_tolerance (= 32 eps_f32 *
+      (||q||^2 + max||t||^2)) — the kernel's tn - 2*qt pipeline has two
+      f32 reduction trees where the count pass has one fused expansion,
+      and the on-device certificate adds an f32 q_norm reduction of its
+      own.
     - "bf16x3": the dropped ql.tl term and the low-part rounding are each
       <= 2^-17 (||q||^2 + max||t||^2)/2; 2^-14 gives ~8x headroom (and
-      subsumes the f32 accumulation term).
+      subsumes every f32 accumulation term).
     """
     from knn_tpu.ops.certified import certification_tolerance
 
@@ -439,7 +442,7 @@ def kernel_tolerance(
         q_norm = (queries_np.astype(np.float64) ** 2).sum(-1)
     if db_norm_max is None:
         db_norm_max = float((db_np.astype(np.float64) ** 2).sum(-1).max())
-    base = 2.0 * certification_tolerance(
+    base = 4.0 * certification_tolerance(
         queries_np, db_np, db_norm_max=db_norm_max, q_norm=q_norm
     )
     if precision == "bf16x3":

@@ -16,7 +16,7 @@ covers it at SIFT1M scale; recall checks in bench.py verify empirically.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -41,81 +41,68 @@ def _pairwise_f64(queries: np.ndarray, cand: np.ndarray, metric: str) -> np.ndar
     raise ValueError(f"unknown metric {metric!r}")
 
 
-def rank_correct(
-    d32: np.ndarray,
+def rank_correct_runs(
     gi: np.ndarray,
+    tight: np.ndarray,
     k: int,
     queries_np: np.ndarray,
     db_np: np.ndarray,
-    slack: float,
-    window_extra: int = 16,
-) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Targeted float64 repair of a device-ranked candidate list.
+    d32k: Optional[np.ndarray] = None,
+) -> Tuple[Optional[np.ndarray], np.ndarray, int]:
+    """Float64 repair of a device-ranked candidate list from the near-tie
+    mask ALONE — no distance matrix crosses the device->host link.
 
-    ``d32`` [Q, m] direct-difference float32 distances (as float64),
-    sorted ascending with ``gi`` their db indices; the device rank is
-    exact wherever adjacent gaps exceed ``slack * d`` (the f32 error
-    band).  Near-ties are COMMON at million-point scale, but each one
-    involves only a couple of candidates — so instead of re-refining
-    whole queries (the cost this function exists to kill: a full float64
-    refine is ~30x more gathered rows), only the entries of tight pairs
-    are re-scored in float64 and their window re-sorted.
+    ``gi`` [Q, m1] device-ranked candidate indices; ``tight`` [Q, W-1]
+    bool marks adjacent pairs closer than the f32 rank slack, already
+    restricted by the device program to finite values before the top-k
+    boundary's first big gap (rows with no provable boundary were flagged
+    ``bad`` there and rerun exactly — they never reach this function's
+    fast path).  Members of each maximal run of tight pairs are re-scored
+    in float64 and re-sorted lexicographically IN PLACE: a correction can
+    never cross an uninvolved neighbor, because the gap there exceeds the
+    slack while corrections move less than a third of it.
 
-    Correctness: a corrected entry moves by <= the f32 error (< slack/3
-    of its value), and every uninvolved neighbor is > slack away, so
-    corrections can never cross an uninvolved entry.  The top-k set
-    boundary is cleared by locating the first big gap at pair index
-    >= k-1; rows where no big gap exists inside the analysis window
-    (or with non-finite values near the boundary) fall back to a full
-    :func:`refine_exact`.
+    ``d32k`` [Q, k] float64 (optional): the device's top-k distances;
+    when given, corrected positions < k get their exact float64 values
+    patched in and the array is returned — None skips distance output
+    entirely (callers that only need indices save the transfer).
 
-    Returns (d [Q, k] float64, i [Q, k] int64, corrected_query_count).
+    Returns (d_out or None, i_out [Q, k] int64, corrected_row_count).
     """
-    n_q, m1 = d32.shape
-    if m1 < k + 1:
-        raise ValueError(f"need >= {k + 1} ranked candidates, got {m1}")
-    W = min(k + 1 + window_extra, m1)
-    dw = d32[:, :W].astype(np.float64).copy()
-    gw = gi[:, :W].astype(np.int64)
-    pair = np.arange(W - 1)
-    with np.errstate(invalid="ignore"):
-        tight = np.diff(dw, axis=-1) <= slack * dw[:, 1:]
-    big_after = (~tight) & (pair[None, :] >= k - 1)
-    has_stop = big_after.any(axis=-1)
-    stop = np.where(has_stop, big_after.argmax(axis=-1), W - 1)
-
-    full = (~has_stop) | ~np.isfinite(dw[:, : k + 1]).all(axis=-1)
-    tight_use = tight & (pair[None, :] < stop[:, None]) & ~full[:, None]
-    inv = np.zeros((n_q, W), dtype=bool)
-    inv[:, :-1] |= tight_use
-    inv[:, 1:] |= tight_use
-    # a sentinel inside a tight pair means the window is degenerate
-    full |= (inv & (gw >= db_np.shape[0])).any(axis=-1)
-    inv &= ~full[:, None]
-
+    n_q, m1 = gi.shape
+    w = tight.shape[1] + 1
+    if w < k:
+        raise ValueError(f"tie mask window {w} < k={k}")
+    inv = np.zeros((n_q, w), dtype=bool)
+    inv[:, :-1] |= tight
+    inv[:, 1:] |= tight
+    d_out = d32k.copy() if d32k is not None else None
     rows, cols = np.nonzero(inv)
-    if rows.size:
-        cand = gw[rows, cols]
-        diff = db_np[cand].astype(np.float64) - queries_np[rows].astype(
-            np.float64
-        )
-        dw[rows, cols] = (diff * diff).sum(-1)
-        rr = np.flatnonzero(inv.any(axis=-1))
-        srt = np.lexsort((gw[rr], dw[rr]), axis=-1)
-        dw[rr] = np.take_along_axis(dw[rr], srt, axis=-1)
-        gw[rr] = np.take_along_axis(gw[rr], srt, axis=-1)
-
-    d_out = dw[:, :k]
-    i_out = gw[:, :k]
-    full_rows = np.flatnonzero(full)
-    if full_rows.size:
-        d_f, i_f = refine_exact(
-            db_np, queries_np[full_rows], gi[full_rows], k
-        )
-        d_out[full_rows] = d_f
-        i_out[full_rows] = i_f
-    n_corrected = int(inv.any(axis=-1).sum()) + int(full_rows.size)
-    return d_out, i_out, n_corrected
+    if rows.size == 0:
+        return d_out, gi[:, :k].astype(np.int64), 0
+    gw = gi[:, :w].astype(np.int64).copy()
+    cand = gw[rows, cols]
+    safe = np.clip(cand, 0, db_np.shape[0] - 1)
+    diff = db_np[safe].astype(np.float64) - queries_np[rows].astype(
+        np.float64
+    )
+    d64 = np.einsum("nd,nd->n", diff, diff)
+    d64 = np.where(cand < db_np.shape[0], d64, np.inf)
+    # maximal runs of consecutive involved positions; (rows, cols) comes
+    # position-sorted from nonzero, so each run is one contiguous block
+    new_run = np.ones(rows.size, dtype=bool)
+    new_run[1:] = (rows[1:] != rows[:-1]) | (cols[1:] != cols[:-1] + 1)
+    run_id = np.cumsum(new_run) - 1
+    # lexicographic sort within each run; runs are contiguous ascending in
+    # both the original flat order and the (run_id-primary) sorted order,
+    # so flat positions realign block-for-block
+    order = np.lexsort((cand, d64, run_id))
+    gw[rows, cols] = cand[order]
+    if d_out is not None:
+        in_k = cols < k
+        d_sorted = d64[order]
+        d_out[rows[in_k], cols[in_k]] = d_sorted[in_k]
+    return d_out, gw[:, :k], int(len(np.unique(rows)))
 
 
 def refine_exact(

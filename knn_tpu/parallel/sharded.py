@@ -315,7 +315,7 @@ class ShardedKNN:
     def search_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None, tile_n: Optional[int] = None,
-        precision: str = "highest",
+        precision: str = "highest", return_distances: bool = True,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
         Returns (dists_f64, idx, stats).  L2 only (the certificate is a
@@ -336,6 +336,11 @@ class ShardedKNN:
         ops.pallas_knn.RANK_SLACK = 2^-18) except for near-tied or
         repaired entries, which are float64-exact — the cost of skipping
         the host refine that would otherwise cap throughput at ~4k q/s.
+
+        ``return_distances=False`` (pallas selector only) returns
+        ``(None, idx, stats)`` and skips the top-k distance block's
+        device->host transfer — label-only consumers (predict) get the
+        indices ~25% faster through a slow link.
 
         ``batch_size`` streams the queries in fixed-size batches with the
         device stages pipelined against the host stages: every batch's
@@ -375,10 +380,11 @@ class ShardedKNN:
         d = np.empty((n_q, self.k))
         i = np.empty((n_q, self.k), dtype=np.int64)
 
+        want_d = return_distances or selector != "pallas"
         if selector == "pallas":
             bad, n_corrected = self._certify_pallas(
                 batches, bs, m, d, i, q_np, db_np, db_norm_max,
-                tile_n=tile_n, precision=precision,
+                tile_n=tile_n, precision=precision, want_distances=want_d,
             )
         else:
             bad = self._certify_counted(
@@ -413,7 +419,7 @@ class ShardedKNN:
         }
         if selector == "pallas":
             stats["rank_corrected_queries"] = n_corrected
-        return d, i, stats
+        return (d if want_d else None), i, stats
 
     def _certify_counted(
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, selector
@@ -468,6 +474,15 @@ class ShardedKNN:
         different programs."""
         from knn_tpu.ops.pallas_knn import BIN_W, TILE_N
 
+        if precision not in ("bf16x3", "highest"):
+            # "default" has no certified tolerance model (its matmul error
+            # is ~2^-10 relative — certificate-hostile); refuse rather
+            # than silently certify garbage
+            raise ValueError(
+                f"precision {precision!r} has no certified tolerance "
+                f"model; use 'bf16x3' or 'highest'"
+            )
+
         shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
         eff_tile = min(tile_n or TILE_N,
                        max(BIN_W, -(-shard_rows // BIN_W) * BIN_W))
@@ -483,71 +498,52 @@ class ShardedKNN:
                 f"selector='approx'"
             )
         prog = _pallas_certified_program(
-            self.mesh, m, self.merge, tile_n, precision,
+            self.mesh, m, self.k, self.merge, tile_n, precision,
             n_train=self.n_train,
         )
         return prog, m
 
     def _certify_pallas(
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, *,
-        tile_n, precision,
+        tile_n, precision, want_distances=True,
     ):
-        """One-pass certificate: the fused kernel's exclusion bound lb
-        certifies each query directly (s_k + tol < lb proves no point
-        outside the candidate set can beat the k-th neighbor), and the
-        device rank stage's direct-difference f32 ordering stands in for
-        the float64 host refine — queries whose adjacent candidate gaps
-        fall inside the f32 error band (RANK_SLACK) escalate to the exact
-        host refine instead.  On >1 db shard a second check covers
-        merge-dropped candidates via the (m+1)-th merged distance.
-        Returns (flagged query indices, rank-corrected query count)."""
-        from knn_tpu.ops.pallas_knn import RANK_SLACK, kernel_tolerance
-        from knn_tpu.ops.refine import rank_correct
+        """One-pass certificate, host side.  The device already ranked the
+        candidates, flagged uncertified rows, and marked near-tie pairs
+        (_pallas_certified_program); the host fetches ONLY indices, the
+        tight-pair mask, and the bad flags (plus the top-k distance block
+        when ``want_distances``) — the [Q, m+1] score matrix never crosses
+        the slow device->host link — then repairs tie runs in float64
+        (ops.refine.rank_correct_runs).  Returns (flagged query indices,
+        rank-corrected query count)."""
+        from knn_tpu.ops.refine import rank_correct_runs
 
         k = self.k
-        db_shards = self.mesh.shape[DB_AXIS]
         prog, m = self._pallas_setup(m - self.k, tile_n, precision)
 
         # stage 1: dispatch every batch (async on device)
+        norm_op = np.float32(db_norm_max)
         outs = []
         for lo, chunk, pad in batches:
             qp, _ = self._place_queries(chunk)
-            outs.append(prog(qp, self._tp))
+            outs.append(prog(qp, self._tp, norm_op))
 
-        # stage 2: per batch — sync candidates + bound; targeted float64
-        # correction of near-tied pairs; certify against lb
-        q_norm = (q_np.astype(np.float64) ** 2).sum(-1)
-        tol = kernel_tolerance(
-            q_np, db_np, db_norm_max=db_norm_max, precision=precision,
-            q_norm=q_norm,
-        )
+        # stage 2: per batch — fetch the small outputs, repair tie runs
         bad_mask = np.zeros(q_np.shape[0], dtype=bool)
         n_corrected = 0
-        for (lo, chunk, pad), (d32, gi, lb) in zip(batches, outs):
+        for (lo, chunk, pad), (d32, gi, tight, bad) in zip(batches, outs):
             take = bs - pad
-            d32 = np.asarray(d32)[:take].astype(np.float64)
-            gi = np.asarray(gi)[:take]
-            lb = np.asarray(lb)[:take].astype(np.float64)
-
-            dc, ic, n_c = rank_correct(
-                d32, gi, k, q_np[lo : lo + take], db_np, RANK_SLACK
+            gi_np = np.asarray(gi)[:take]
+            tight_np = np.asarray(tight)[:take].astype(bool)
+            dk = (np.asarray(d32[:, :k])[:take].astype(np.float64)
+                  if want_distances else None)
+            dc, ic, n_c = rank_correct_runs(
+                gi_np, tight_np, k, q_np[lo : lo + take], db_np, d32k=dk
             )
             n_corrected += n_c
-            d[lo : lo + take] = dc
+            if dc is not None:
+                d[lo : lo + take] = dc
             i[lo : lo + take] = ic
-
-            # certificate: d_k carries the f32 rank slack (corrected
-            # entries are exact, but slack at this scale is negligible
-            # next to the kernel tolerance, so apply it uniformly)
-            d_k = dc[:, k - 1]
-            s_k = d_k - q_norm[lo : lo + take]
-            bad = s_k + RANK_SLACK * d_k + tol[lo : lo + take] >= lb
-            if db_shards > 1:
-                # merge-dropped candidates have direct-diff f32 distance
-                # >= the (m+1)-th kept; require true-distance clearance
-                v_excl = d32[:, m] * (1.0 - RANK_SLACK)
-                bad |= d_k + RANK_SLACK * d_k >= v_excl
-            bad_mask[lo : lo + take] = bad
+            bad_mask[lo : lo + take] = np.asarray(bad)[:take].astype(bool)
         return np.flatnonzero(bad_mask), n_corrected
 
     def predict_certified(
@@ -563,6 +559,7 @@ class ShardedKNN:
         _, idx, stats = self.search_certified(
             queries, margin=margin, selector=selector, batch_size=batch_size,
             tile_n=tile_n, precision=precision,
+            return_distances=False,  # labels only: skip the d transfer
         )
         labels_host = np.asarray(self._labels)
         votes = majority_vote(jnp.asarray(labels_host[idx]), self.num_classes)
@@ -665,24 +662,45 @@ def sharded_knn_predict(
 
 @functools.lru_cache(maxsize=32)
 def _pallas_certified_program(
-    mesh: Mesh, m: int, merge: str, tile_n: Optional[int], precision: str,
-    n_train: Optional[int] = None,
+    mesh: Mesh, m: int, k: int, merge: str, tile_n: Optional[int],
+    precision: str, n_train: Optional[int] = None,
 ):
-    """ONE-pass sharded self-certifying coarse select + device rank
-    (ops.pallas_knn.local_certified_candidates per shard): candidates
-    arrive as direct-difference f32 distances already in lexicographic
-    order, merged across the db axis (ring/allgather as usual) while the
-    kernel-space exclusion bounds pmin.  Returns (d32 [Q, m+1], global idx
-    [Q, m+1], lb [Q]): every db row outside the returned candidates has
-    kernel score >= lb, OR was merge-dropped and has direct-difference
-    distance >= d32[:, m] — the two-part certificate _certify_pallas
-    checks.  No count-below pass, no unconditional host refine."""
-    from knn_tpu.ops.pallas_knn import TILE_N, local_certified_candidates
+    """ONE-pass sharded self-certifying coarse select + device rank +
+    device certificate (ops.pallas_knn.local_certified_candidates per
+    shard): candidates arrive as direct-difference f32 distances already
+    in lexicographic order, merged across the db axis (ring/allgather as
+    usual) while the kernel-space exclusion bounds pmin.
+
+    The certificate and the near-tie analysis run ON DEVICE so the host
+    only fetches what it uses — through a slow device->host link (the dev
+    harness relay moves ~13 MB/s) the [Q, m+1] f32 score matrix would
+    otherwise dominate the sweep.  Program outputs:
+
+      d32   [Q, m+1] f32   ranked direct-difference distances (fetched
+                           only when the caller wants distance values),
+      gi    [Q, m+1] i32   their global db row indices,
+      tight [Q, W-1] i8    near-tie mask over the analysis window W =
+                           min(k+17, m+1): pair j is 1 when positions
+                           j, j+1 are closer than RANK_SLACK and sit
+                           before the top-k set boundary's first big gap,
+      bad   [Q]      i8    uncertified OR boundary-unresolvable rows
+                           (repair reruns them exactly).
+
+    Soundness: a db row outside the candidates has kernel score >= lb,
+    or was merge-dropped with direct distance >= d32[:, m]; ``bad`` is
+    the union of both checks plus rows whose tie run crosses the
+    analysis window (no provable top-k boundary)."""
+    from knn_tpu.ops.pallas_knn import (
+        RANK_SLACK,
+        TILE_N,
+        local_certified_candidates,
+    )
 
     db_shards = mesh.shape[DB_AXIS]
     eff_tile = tile_n or TILE_N
+    w = min(k + 17, m + 1)
 
-    def spmd(q, t):
+    def spmd(q, t, db_norm_max):
         d32, li, lb = local_certified_candidates(
             q, t, m, tile_n=eff_tile, precision=precision
         )
@@ -703,14 +721,52 @@ def _pallas_certified_program(
             else:
                 d32, gi = _allgather_merge(d32, gi, m + 1, DB_AXIS)
             lb = lax.pmin(lb, axis_name=DB_AXIS)
-        return d32, gi, lb
+
+        # --- device rank analysis over the window [0, w) ---------------
+        dw = d32[:, :w]
+        gaps = dw[:, 1:] - dw[:, :-1]  # [Q, w-1]
+        # isfinite guard: an (x, inf-sentinel) pair yields inf <= inf,
+        # which must not count as a near-tie
+        tight = (gaps <= RANK_SLACK * dw[:, 1:]) & jnp.isfinite(dw[:, 1:])
+        pair = lax.broadcasted_iota(jnp.int32, tight.shape, 1)
+        big_after = (~tight) & (pair >= k - 1)
+        has_stop = big_after.any(axis=-1)
+        stop = jnp.where(has_stop, jnp.argmax(big_after, axis=-1), w - 1)
+        # rows without a provable boundary (or junk near it) rerun exactly
+        unresolved = (~has_stop) | ~jnp.isfinite(dw[:, : k + 1]).all(-1)
+        tight_use = tight & (pair < stop[:, None]) & ~unresolved[:, None]
+
+        # --- device certificate ----------------------------------------
+        # tolerances mirror ops.pallas_knn.kernel_tolerance and include
+        # the extra f32 reduction this on-device path adds (q_norm +
+        # s_k arithmetic, <= ~12 eps of the norm scale): "highest" budgets
+        # 32 eps total; bf16x3's 2^-14 dwarfs the f32 terms either way
+        q32 = q.astype(jnp.float32)
+        q_norm = jnp.sum(q32 * q32, axis=-1)
+        if precision == "bf16x3":
+            tol = 2.0 ** -14 * (q_norm + db_norm_max)
+        else:
+            tol = 32.0 * float(np.finfo(np.float32).eps) * (
+                q_norm + db_norm_max)
+        d_k = dw[:, k - 1]
+        s_k = d_k - q_norm
+        bad = s_k + RANK_SLACK * d_k + tol >= lb
+        if db_shards > 1:
+            # merge-dropped candidates have direct-diff f32 distance
+            # >= the (m+1)-th kept; require true-distance clearance
+            bad = bad | (d_k + RANK_SLACK * d_k
+                         >= d32[:, m] * (1.0 - RANK_SLACK))
+        bad = bad | unresolved
+        return (d32, gi, tight_use.astype(jnp.int8),
+                bad.astype(jnp.int8))
 
     return jax.jit(
         jax.shard_map(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS)),
-            out_specs=(P(QUERY_AXIS), P(QUERY_AXIS), P(QUERY_AXIS)),
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P()),
+            out_specs=(P(QUERY_AXIS), P(QUERY_AXIS), P(QUERY_AXIS),
+                       P(QUERY_AXIS)),
             check_vma=False,
         )
     )

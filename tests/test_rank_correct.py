@@ -1,74 +1,97 @@
-"""rank_correct: targeted float64 repair of a device-ranked (f32
-direct-difference) candidate list — the pallas certified path's stand-in
-for the full host refine.  Property under test: for ANY candidate list
+"""rank_correct_runs: targeted float64 repair of a device-ranked candidate
+list driven by the near-tie mask alone — the pallas certified path's
+stand-in for the full host refine.  Property under test: for ANY ranking
 whose f32 values are within the slack band of the true distances, the
-output must equal refine_exact on the same candidates, bitwise."""
+output (on rows the device would NOT flag bad) must equal refine_exact on
+the same candidates."""
 
 import numpy as np
 import pytest
 
-from knn_tpu.ops.refine import rank_correct, refine_exact
+from knn_tpu.ops.refine import rank_correct_runs, refine_exact
+
+SLACK = 2.0 ** -18
 
 
-def _device_rank(db, queries, m, rel_noise, rng):
-    """Simulate the device stage: true f64 distances + bounded relative
-    noise, sorted by the noisy value with index tie-break."""
+def _device_sim(db, queries, m, k, rel_noise, rng, window_extra=16):
+    """Simulate the device stage exactly as _pallas_certified_program
+    computes it: noisy ranked distances, tight mask restricted to finite
+    pairs before the first big gap at pair index >= k-1, and the
+    unresolved flag."""
     d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None]) ** 2).sum(-1)
     noisy = d * (1.0 + rel_noise * (rng.random(d.shape) * 2 - 1))
     order = np.lexsort((np.broadcast_to(np.arange(d.shape[1]), d.shape), noisy))
-    idx = order[:, :m]
-    return np.take_along_axis(noisy, idx, -1), idx
+    gi = order[:, :m]
+    dv = np.take_along_axis(noisy, gi, -1).astype(np.float32).astype(np.float64)
+    w = min(k + 1 + window_extra, m)
+    dw = dv[:, :w]
+    gaps = dw[:, 1:] - dw[:, :-1]
+    tight = (gaps <= SLACK * dw[:, 1:]) & np.isfinite(dw[:, 1:])
+    pair = np.arange(w - 1)
+    big_after = (~tight) & (pair[None, :] >= k - 1)
+    has_stop = big_after.any(-1)
+    stop = np.where(has_stop, big_after.argmax(-1), w - 1)
+    unresolved = (~has_stop) | ~np.isfinite(dw[:, : k + 1]).all(-1)
+    tight_use = tight & (pair[None, :] < stop[:, None]) & ~unresolved[:, None]
+    return gi, dv, tight_use, unresolved
 
 
 @pytest.mark.parametrize("rel_noise", [0.0, 1e-6, 1.5e-6])
-def test_rank_correct_matches_full_refine(rng, rel_noise):
-    # precondition: slack must cover the two-sided pair error, i.e.
-    # 2 * rel_noise <= slack (the kernel's true error is ~1.2e-6)
-    slack = 2.0 ** -18
+def test_rank_correct_runs_matches_full_refine(rng, rel_noise):
+    # precondition: slack covers the two-sided pair error (2*rel <= slack)
     db = rng.normal(size=(600, 12)).astype(np.float32) * 10
     db[100:140] = db[:40]  # exact duplicates -> exactly tied distances
     queries = rng.normal(size=(64, 12)).astype(np.float32) * 10
-    d32, gi = _device_rank(db, queries, 25, rel_noise, rng)
-    d, i, n_c = rank_correct(d32, gi, 9, queries, db, slack)
+    gi, dv, tight, unresolved = _device_sim(db, queries, 25, 9, rel_noise, rng)
+    d, i, n_c = rank_correct_runs(gi, tight, 9, queries, db,
+                                  d32k=dv[:, :9].copy())
     ref_d, ref_i = refine_exact(db, queries, gi, 9)
-    np.testing.assert_array_equal(i, ref_i)
-    np.testing.assert_allclose(d, ref_d, rtol=max(4 * rel_noise, 1e-12))
+    ok = ~unresolved  # device flags unresolved rows bad -> repair path
+    np.testing.assert_array_equal(i[ok], ref_i[ok])
+    # uncorrected entries carry device f32 values (the contract), so the
+    # distance tolerance floors at f32 rounding
+    np.testing.assert_allclose(d[ok], ref_d[ok], rtol=max(4 * rel_noise, 2e-7))
 
 
-def test_rank_correct_counts_and_skips_clean_rows(rng):
-    db = rng.normal(size=(500, 8)).astype(np.float32) * 100
-    queries = rng.normal(size=(16, 8)).astype(np.float32) * 100
-    d32, gi = _device_rank(db, queries, 20, 0.0, rng)
-    # well-separated random data: float64-exact inputs, generous spacing
-    d, i, n_c = rank_correct(d32, gi, 5, queries, db, 2.0 ** -18)
+def test_rank_correct_runs_without_distances(rng):
+    db = rng.normal(size=(400, 8)).astype(np.float32) * 10
+    db[30:50] = db[:20]
+    queries = rng.normal(size=(16, 8)).astype(np.float32) * 10
+    gi, dv, tight, unresolved = _device_sim(db, queries, 20, 5, 1e-6, rng)
+    d, i, n_c = rank_correct_runs(gi, tight, 5, queries, db, d32k=None)
+    assert d is None
     ref_d, ref_i = refine_exact(db, queries, gi, 5)
-    np.testing.assert_array_equal(i, ref_i)
+    ok = ~unresolved
+    np.testing.assert_array_equal(i[ok], ref_i[ok])
 
 
-def test_rank_correct_degenerate_rows_full_refine(rng):
-    # heavy ties across the whole window force the full-refine path
-    db = np.ones((300, 6), dtype=np.float32)
-    db[250:] = 2.0
-    queries = np.zeros((4, 6), dtype=np.float32)
-    d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None]) ** 2).sum(-1)
-    order = np.argsort(d, axis=-1, kind="stable")[:, :30]
-    d32 = np.take_along_axis(d, order, -1)
-    d_out, i_out, n_c = rank_correct(d32, order, 7, queries, db, 2.0 ** -18)
-    ref_d, ref_i = refine_exact(db, queries, order, 7)
-    np.testing.assert_array_equal(i_out, ref_i)
-    np.testing.assert_array_equal(d_out, ref_d)
-    assert n_c == 4  # every row needed repair
+def test_rank_correct_runs_corrected_entries_are_float64(rng):
+    # duplicates force runs; corrected positions must carry exact f64
+    db = rng.normal(size=(300, 6)).astype(np.float32)
+    db[10:14] = db[5]  # five-way tie
+    queries = (db[5][None] + 0.01).astype(np.float32)
+    gi, dv, tight, unresolved = _device_sim(db, queries, 20, 7, 0.0, rng)
+    assert tight.any(), "fixture must produce at least one tie run"
+    d, i, n_c = rank_correct_runs(gi, tight, 7, queries, db,
+                                  d32k=dv[:, :7].copy())
+    ref_d, ref_i = refine_exact(db, queries, gi, 7)
+    ok = ~unresolved
+    np.testing.assert_array_equal(i[ok], ref_i[ok])
+    # the five-way tie run occupies the leading positions: those entries
+    # must be float64-exact; trailing uncorrected ones are f32-accurate
+    np.testing.assert_array_equal(d[ok][:, :5], ref_d[ok][:, :5])
+    np.testing.assert_allclose(d[ok], ref_d[ok], rtol=2e-7)
+    assert n_c >= 1
 
 
-def test_rank_correct_sentinel_candidates(rng):
-    db = rng.normal(size=(64, 5)).astype(np.float32)
-    queries = rng.normal(size=(3, 5)).astype(np.float32)
-    d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None]) ** 2).sum(-1)
-    order = np.argsort(d, axis=-1, kind="stable")
-    d32 = np.take_along_axis(d, order, -1)
-    # append sentinel (inf, i32max) slots as the kernel pads them
-    d32 = np.concatenate([d32, np.full((3, 8), np.inf)], axis=-1)
-    gi = np.concatenate([order, np.full((3, 8), 2**31 - 1, np.int64)], axis=-1)
-    d_out, i_out, _ = rank_correct(d32, gi, 4, queries, db, 2.0 ** -18)
+def test_rank_correct_runs_clean_rows_untouched(rng):
+    # well-separated data: no tight pairs, zero corrections, passthrough
+    db = (rng.normal(size=(200, 8)) * 100).astype(np.float32)
+    queries = (rng.normal(size=(9, 8)) * 100).astype(np.float32)
+    gi, dv, tight, unresolved = _device_sim(db, queries, 15, 4, 0.0, rng)
+    d, i, n_c = rank_correct_runs(gi, tight, 4, queries, db,
+                                  d32k=dv[:, :4].copy())
+    assert n_c == int(tight.any(-1).sum())
     ref_d, ref_i = refine_exact(db, queries, gi, 4)
-    np.testing.assert_array_equal(i_out, ref_i)
+    ok = ~unresolved
+    np.testing.assert_array_equal(i[ok], ref_i[ok])

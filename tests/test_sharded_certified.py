@@ -125,3 +125,28 @@ def test_sharded_certified_batched_matches_unbatched(data, batch_size):
     np.testing.assert_array_equal(i, ref_i)
     np.testing.assert_array_equal(d, ref_d)
     assert stats["certified"] + stats["fallback_queries"] == queries.shape[0]
+
+
+def test_pallas_certified_beats_f32_cancellation(rng):
+    # at tiny distances vs large norms the expanded-square f32 "exact"
+    # path loses ~all its bits (catastrophic cancellation); the pallas
+    # path's direct-difference rank + tie runs + repair must still match
+    # the FLOAT64 oracle (which the f32 exact path here cannot)
+    from knn_tpu.ops.certified import host_exact_knn
+
+    db = rng.normal(size=(3000, 10)).astype(np.float32) * 10
+    db[200:260] = db[:60]          # duplicate ties
+    db[500:540] = db[0] + 0.0001   # 40-way pileup nearer than db[0] itself
+    queries = np.vstack([
+        db[0][None] + 0.01,
+        rng.normal(size=(15, 10)).astype(np.float32) * 10,
+    ]).astype(np.float32)
+    od, oi = host_exact_knn(db, queries, 12)
+    for mesh_shape in [(8, 1), (2, 4)]:
+        prog = ShardedKNN(db, mesh=make_mesh(*mesh_shape), k=12)
+        for wd in (True, False):
+            d, i, stats = prog.search_certified(
+                queries, selector="pallas", tile_n=256, return_distances=wd
+            )
+            np.testing.assert_array_equal(i, oi)
+            assert (d is None) == (not wd)
