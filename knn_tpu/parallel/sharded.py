@@ -315,7 +315,7 @@ class ShardedKNN:
     def search_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None, tile_n: Optional[int] = None,
-        precision: str = "highest", return_distances: bool = True,
+        precision: str = "bf16x3", return_distances: bool = True,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
         Returns (dists_f64, idx, stats).  L2 only (the certificate is a
@@ -337,10 +337,12 @@ class ShardedKNN:
         repaired entries, which are float64-exact — the cost of skipping
         the host refine that would otherwise cap throughput at ~4k q/s.
 
-        ``return_distances=False`` (pallas selector only) returns
-        ``(None, idx, stats)`` and skips the top-k distance block's
-        device->host transfer — label-only consumers (predict) get the
-        indices ~25% faster through a slow link.
+        ``return_distances=False`` returns ``(None, idx, stats)`` for any
+        selector; on the pallas selector it also skips the top-k distance
+        block's device->host transfer — worth ~20-25% at SIFT shape
+        through a slow link, negligible when the sweep is
+        compute-dominated (the published gist1m numbers differ only
+        within run-to-run noise).
 
         ``batch_size`` streams the queries in fixed-size batches with the
         device stages pipelined against the host stages: every batch's
@@ -380,11 +382,11 @@ class ShardedKNN:
         d = np.empty((n_q, self.k))
         i = np.empty((n_q, self.k), dtype=np.int64)
 
-        want_d = return_distances or selector != "pallas"
         if selector == "pallas":
             bad, n_corrected = self._certify_pallas(
                 batches, bs, m, d, i, q_np, db_np, db_norm_max,
-                tile_n=tile_n, precision=precision, want_distances=want_d,
+                tile_n=tile_n, precision=precision,
+                want_distances=return_distances,
             )
         else:
             bad = self._certify_counted(
@@ -419,7 +421,7 @@ class ShardedKNN:
         }
         if selector == "pallas":
             stats["rank_corrected_queries"] = n_corrected
-        return (d if want_d else None), i, stats
+        return (d if return_distances else None), i, stats
 
     def _certify_counted(
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, selector
@@ -549,7 +551,7 @@ class ShardedKNN:
     def predict_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None, tile_n: Optional[int] = None,
-        precision: str = "highest",
+        precision: str = "bf16x3",
     ):
         """Certified-exact classification: exact neighbor sets from
         :meth:`search_certified`, then the reference vote (ops.vote).
