@@ -150,3 +150,17 @@ def test_pallas_certified_beats_f32_cancellation(rng):
             )
             np.testing.assert_array_equal(i, oi)
             assert (d is None) == (not wd)
+
+
+@pytest.mark.parametrize("selector", ["approx", "exact", "pallas"])
+def test_return_distances_false_uniform_contract(data, selector):
+    # (None, idx, stats) for EVERY selector — not a pallas-only behavior
+    db, queries = data
+    prog = ShardedKNN(db, mesh=make_mesh(2, 4), k=7)
+    ref_d, ref_i = _oracle(db, queries, 7)
+    kwargs = {"tile_n": 256} if selector == "pallas" else {}
+    d, i, stats = prog.search_certified(
+        queries, selector=selector, return_distances=False, **kwargs
+    )
+    assert d is None
+    np.testing.assert_array_equal(i, ref_i)
