@@ -108,39 +108,60 @@ PRECISIONS = ("bf16x3", "highest", "default")
 RANK_SLACK = 2.0 ** -18
 
 
-def _geometry(tile_n: int) -> Tuple[int, int]:
-    """(n_bins, survivors) for a db tile.  Output blocks are 128 lanes:
-    survivors * n_bins <= 128, padded with +inf/sentinel when the
-    MAX_SURVIVORS cap binds."""
-    if tile_n % BIN_W:
-        raise ValueError(f"tile_n={tile_n} must be a multiple of {BIN_W}")
-    n_bins = tile_n // BIN_W
-    if n_bins > 128:
-        raise ValueError(f"tile_n={tile_n} exceeds 128 bins per cell")
-    return n_bins, min(128 // n_bins, MAX_SURVIVORS, BIN_W)
+def _round_up(x: int, multiple: int) -> int:
+    return -(-x // multiple) * multiple
 
 
-def _kernel(q_ref, t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch,
-            tile_n: int, n_bins: int, survivors: int, nd: int, precision: str):
+def _geometry(
+    tile_n: int, bin_w: int = BIN_W, survivors: Optional[int] = None
+) -> Tuple[int, int, int, int]:
+    """(n_bins, survivors, out_w, bound_w) for a db tile.  Output blocks
+    are lane-aligned: ``out_w = round_up(n_bins * survivors, 128)`` lanes
+    of candidates per cell (padded with +inf/sentinel), ``bound_w`` lanes
+    of per-bin exclusion bounds.  ``survivors=None`` picks the largest
+    count that fits one 128-lane block (the legacy geometry)."""
+    if tile_n % bin_w:
+        raise ValueError(f"tile_n={tile_n} must be a multiple of bin_w={bin_w}")
+    if bin_w % BIN_W:
+        raise ValueError(f"bin_w={bin_w} must be a multiple of {BIN_W} lanes")
+    n_bins = tile_n // bin_w
+    if survivors is None:
+        survivors = max(1, min(128 // n_bins, MAX_SURVIVORS, bin_w))
+    # the MAX_SURVIVORS cap applies to explicit requests too: each
+    # survivor is an unrolled min/argmin sweep in the kernel trace
+    survivors = min(survivors, MAX_SURVIVORS, bin_w)
+    return n_bins, survivors, _round_up(n_bins * survivors, 128), _round_up(
+        n_bins, 128)
+
+
+def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
+            survivors: int, out_w: int, bound_w: int, nd: int,
+            precision: str):
     ti = pl.program_id(1)
     di = pl.program_id(2)
     q = q_ref[:]
-    t = t_ref[:]
     dn = (((1,), (1,)), ((), ()))
     if precision == "bf16x3":
+        # db high/low bf16 parts arrive PRECOMPUTED (one XLA pass per
+        # call instead of a per-cell VPU split redone for every query
+        # block); only the small q block splits in-kernel
+        th_ref, tl_ref, tn_ref, d_ref, i_ref, b_ref, *scratch = refs
+        th = th_ref[:]
         qh = q.astype(jnp.bfloat16)
-        th = t.astype(jnp.bfloat16)
         ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
-        tl = (t - th.astype(jnp.float32)).astype(jnp.bfloat16)
         # q.t = qh.th + qh.tl + ql.th (+ ql.tl dropped: <= 2^-18 |q||t|,
         # covered by kernel_tolerance's 2^-14 factor)
         qt = (lax.dot_general(qh, th, dn, preferred_element_type=jnp.float32)
-              + lax.dot_general(qh, tl, dn, preferred_element_type=jnp.float32)
-              + lax.dot_general(ql, th, dn, preferred_element_type=jnp.float32))
+              + lax.dot_general(qh, tl_ref[:], dn,
+                                preferred_element_type=jnp.float32)
+              + lax.dot_general(ql, th, dn,
+                                preferred_element_type=jnp.float32))
     else:
+        t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch = refs
         prec = (lax.Precision.HIGHEST if precision == "highest"
                 else lax.Precision.DEFAULT)
-        qt = lax.dot_general(q, t, dn, preferred_element_type=jnp.float32,
+        qt = lax.dot_general(q, t_ref[:], dn,
+                             preferred_element_type=jnp.float32,
                              precision=prec)  # [BQ, T]
     # db row norms arrive precomputed ([8, T] broadcast, row 0 used): an
     # XLA f32 reduction once per call instead of a per-cell ones-matmul
@@ -150,7 +171,8 @@ def _kernel(q_ref, t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch,
         # accumulation round-trip entirely (measured ~16% of kernel time
         # at SIFT shape)
         _emit_select(ti, qt, tn_ref[:], d_ref, i_ref, b_ref,
-                     tile_n=tile_n, n_bins=n_bins, survivors=survivors)
+                     tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
+                     survivors=survivors, out_w=out_w, bound_w=bound_w)
         return
     qt_ref, = scratch
 
@@ -165,11 +187,13 @@ def _kernel(q_ref, t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch,
     @pl.when(di == nd - 1)
     def _select():
         _emit_select(ti, qt_ref[:], tn_ref[:], d_ref, i_ref, b_ref,
-                     tile_n=tile_n, n_bins=n_bins, survivors=survivors)
+                     tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
+                     survivors=survivors, out_w=out_w, bound_w=bound_w)
 
 
 def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
-                 tile_n: int, n_bins: int, survivors: int):
+                 tile_n: int, bin_w: int, n_bins: int, survivors: int,
+                 out_w: int, bound_w: int):
     """Binning + survivor/bound emission from an accumulated score tile
     (shared by the single-chunk fast path and the multi-chunk tail;
     ``ti`` is the db-tile program id, hoisted by the caller because
@@ -177,10 +201,10 @@ def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
     interpret mode)."""
     s = tn[0:1, :] - 2.0 * qt  # [BQ, T], ||q||^2 dropped
     bq = s.shape[0]
-    d3 = s.reshape(bq, n_bins, BIN_W)
+    d3 = s.reshape(bq, n_bins, bin_w)
     lane = lax.broadcasted_iota(jnp.int32, d3.shape, 2)
     base = (ti * tile_n
-            + lax.broadcasted_iota(jnp.int32, (bq, n_bins), 1) * BIN_W)
+            + lax.broadcasted_iota(jnp.int32, (bq, n_bins), 1) * bin_w)
     ds, is_ = [], []
     work = d3
     for _ in range(survivors):
@@ -192,7 +216,7 @@ def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
     bound = jnp.min(work, axis=-1)  # (survivors+1)-th smallest per bin
     cd = jnp.concatenate(ds, axis=-1)
     ci = jnp.concatenate(is_, axis=-1)
-    pad = 128 - survivors * n_bins
+    pad = out_w - survivors * n_bins
     if pad:
         cd = jnp.concatenate(
             [cd, jnp.full((bq, pad), jnp.inf, jnp.float32)], axis=-1)
@@ -200,7 +224,7 @@ def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
             [ci, jnp.full((bq, pad), _I32MAX, jnp.int32)], axis=-1)
     d_ref[:] = cd
     i_ref[:] = ci
-    bpad = 128 - n_bins
+    bpad = bound_w - n_bins
     if bpad:
         bound = jnp.concatenate(
             [bound, jnp.full((bq, bpad), jnp.inf, jnp.float32)], axis=-1)
@@ -227,7 +251,8 @@ def _on_tpu() -> bool:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "tile_n", "precision", "interpret")
+    jax.jit, static_argnames=("block_q", "tile_n", "bin_w", "survivors",
+                              "precision", "interpret")
 )
 def _bin_candidates(
     queries: jax.Array,
@@ -235,6 +260,8 @@ def _bin_candidates(
     *,
     block_q: int,
     tile_n: int,
+    bin_w: int,
+    survivors: Optional[int],
     precision: str,
     interpret: bool,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -242,11 +269,12 @@ def _bin_candidates(
 
       cand_d [Qp, W]  f32  per-bin survivor scores (squared L2 - ||q||^2),
       cand_i [Qp, W]  i32  their global db row indices (sentinel = i32 max),
-      bounds [Qp, 128] f32 per-bin-slot exclusion bounds, min-reduced over
+      bounds [Qp, B]  f32  per-bin-slot exclusion bounds, min-reduced over
                            db tiles (lane-min for the scalar bound).
 
-    W = n_tiles * 128.  Zero dim-padding preserves scores exactly; PAD_VAL
-    row-padding scores ~1e36 so pads never surface (module docstring)."""
+    W = n_tiles * out_w (survivors per bin, lane-padded per tile).  Zero
+    dim-padding preserves scores exactly; PAD_VAL row-padding scores
+    ~1e36 so pads never surface (module docstring)."""
     queries = _pad_axis(queries.astype(jnp.float32), block_q, 0)
     queries = _pad_axis(queries, DIM_CHUNK, 1)
     db = _pad_axis(db.astype(jnp.float32), tile_n, 0, fill=PAD_VAL)
@@ -254,7 +282,7 @@ def _bin_candidates(
     qp, dim = queries.shape
     n_tiles = db.shape[0] // tile_n
     nd = dim // DIM_CHUNK
-    n_bins, survivors = _geometry(tile_n)
+    n_bins, survivors, out_w, bound_w = _geometry(tile_n, bin_w, survivors)
     # full-dim db row norms, f32, broadcast to 8 sublanes so the kernel
     # reads them as a lane-major [8, tile_n] block
     tnorm = jnp.broadcast_to(
@@ -264,7 +292,8 @@ def _bin_candidates(
     if precision not in PRECISIONS:
         raise ValueError(f"precision {precision!r} not in {PRECISIONS}")
     kernel = functools.partial(
-        _kernel, tile_n=tile_n, n_bins=n_bins, survivors=survivors, nd=nd,
+        _kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
+        survivors=survivors, out_w=out_w, bound_w=bound_w, nd=nd,
         precision=precision,
     )
     grid = (qp // block_q, n_tiles, nd)
@@ -273,28 +302,43 @@ def _bin_candidates(
         # the [block_q, tile_n] f32 score tile + double-buffered db tile
         # overflow the default 16 MB scoped-vmem budget at large n_tiles;
         # v5e has headroom above it, and the explicit limit keeps the
-        # geometry (tile_n=8192 -> 2 survivors/bin) intact
+        # geometry intact
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
-            vmem_limit_bytes=64 * 1024 * 1024,
+            vmem_limit_bytes=100 * 1024 * 1024,
         )
+    if precision == "bf16x3":
+        # the high/low split of the db happens ONCE in XLA; the kernel
+        # streams bf16 tiles and never re-derives them per query block
+        th = db.astype(jnp.bfloat16)
+        tl = (db - th.astype(jnp.float32)).astype(jnp.bfloat16)
+        db_inputs = [th, tl]
+        db_specs = [
+            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+        ]
+    else:
+        db_inputs = [db]
+        db_specs = [
+            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+        ]
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_q, DIM_CHUNK), lambda qi, ti, di: (qi, di)),
-            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+            *db_specs,
             pl.BlockSpec((8, tile_n), lambda qi, ti, di: (0, ti)),
         ],
         out_specs=[
-            pl.BlockSpec((block_q, 128), lambda qi, ti, di: (qi, ti)),
-            pl.BlockSpec((block_q, 128), lambda qi, ti, di: (qi, ti)),
-            pl.BlockSpec((block_q, 128), lambda qi, ti, di: (qi, 0)),
+            pl.BlockSpec((block_q, out_w), lambda qi, ti, di: (qi, ti)),
+            pl.BlockSpec((block_q, out_w), lambda qi, ti, di: (qi, ti)),
+            pl.BlockSpec((block_q, bound_w), lambda qi, ti, di: (qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((qp, n_tiles * 128), jnp.float32),
-            jax.ShapeDtypeStruct((qp, n_tiles * 128), jnp.int32),
-            jax.ShapeDtypeStruct((qp, 128), jnp.float32),
+            jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.float32),
+            jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.int32),
+            jax.ShapeDtypeStruct((qp, bound_w), jnp.float32),
         ],
         # the qt accumulation scratch is only touched when dim spans
         # multiple chunks; at dim <= 128 (the headline shape) skipping it
@@ -304,12 +348,13 @@ def _bin_candidates(
         ],
         interpret=interpret,
         **kwargs,
-    )(queries, db, tnorm)
+    )(queries, *db_inputs, tnorm)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("m", "tile_n", "block_q", "precision", "interpret"),
+    static_argnames=("m", "tile_n", "block_q", "bin_w", "survivors",
+                     "precision", "final_select", "interpret"),
 )
 def local_certified_candidates(
     q: jax.Array,
@@ -318,7 +363,10 @@ def local_certified_candidates(
     *,
     tile_n: int = TILE_N,
     block_q: int = BLOCK_Q,
+    bin_w: int = BIN_W,
+    survivors: Optional[int] = None,
     precision: str = "bf16x3",
+    final_select: str = "exact",
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole device-side certified coarse pass against one db (shard):
@@ -332,9 +380,12 @@ def local_certified_candidates(
     Three stages, all on device:
 
     1. fused kernel -> per-bin survivors + bin bounds;
-    2. ``approx_max_k`` picks ~(m+1) survivors; the *exact* min over the
-       de-selected survivors (one masked reduction) joins the bin bounds,
-       so the approximate selection cannot silently weaken the bound;
+    2. an exact top-(m+2) (``final_select="exact"``) or an
+       ``approx_max_k`` + exact masked-min (``"approx"``) picks ~(m+1)
+       survivors; either way the exclusion value over the de-selected
+       survivors is EXACT, so the final selection cannot silently weaken
+       the bound — an approx miss only strengthens lb downward, causing
+       a fallback, never an unsound certificate;
     3. the selected rows are gathered and re-scored with direct-difference
        f32 (no catastrophic cancellation — relative error ~1e-6, vs the
        expanded-square kernel score's absolute error at ||q||^2 scale),
@@ -344,10 +395,11 @@ def local_certified_candidates(
     db shards and pmin's lb."""
     if interpret is None:
         interpret = not _on_tpu()
-    eff_tile = min(tile_n, max(BIN_W, -(-t.shape[0] // BIN_W) * BIN_W))
+    eff_tile = min(tile_n, max(bin_w, -(-t.shape[0] // bin_w) * bin_w))
     cd, ci, bounds = _bin_candidates(
         q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
-        precision=precision, interpret=interpret,
+        bin_w=bin_w, survivors=survivors, precision=precision,
+        interpret=interpret,
     )
     n_q = q.shape[0]
     cd, ci, bounds = cd[:n_q], ci[:n_q], bounds[:n_q]
@@ -358,14 +410,28 @@ def local_certified_candidates(
             f"{t.shape[0]}-row shard; lower margin or tile_n, or use the "
             f"approx selector"
         )
-    # exact top-(m+2) by kernel score: the last value is the exclusion
-    # value over every de-selected survivor (approx_max_k is NOT usable
-    # here — its per-element recall target means P(all top-k survive)
-    # decays exponentially in k, the round-2 fallback disease)
-    neg, sel = lax.top_k(-cd, m + 2)
-    vals = -neg
-    lidx = jnp.take_along_axis(ci, sel, axis=-1)[:, : m + 1]
-    lb = jnp.minimum(jnp.min(bounds, axis=-1), vals[:, m + 1])
+    if final_select not in ("exact", "approx"):
+        raise ValueError(
+            f"final_select {final_select!r} not in ('exact', 'approx')")
+    if final_select == "approx":
+        # hardware ApproxTopK over the candidate array, with the exclusion
+        # value restored EXACTLY: every de-selected candidate joins the
+        # bound via a masked min, so a recall miss here can only cause a
+        # fallback, never a wrong certificate.  (~40% cheaper than the
+        # full top_k at SIFT candidate widths.)
+        neg, sel = lax.approx_max_k(-cd, m + 1, recall_target=0.999)
+        vals = -neg
+        lidx = jnp.take_along_axis(ci, sel, axis=-1)
+        masked = cd.at[jnp.arange(n_q)[:, None], sel].set(jnp.inf)
+        excl = jnp.min(masked, axis=-1)
+        lb = jnp.minimum(jnp.min(bounds, axis=-1), excl)
+    else:
+        # exact top-(m+2) by kernel score: the last value is the exclusion
+        # value over every de-selected survivor
+        neg, sel = lax.top_k(-cd, m + 2)
+        vals = -neg
+        lidx = jnp.take_along_axis(ci, sel, axis=-1)[:, : m + 1]
+        lb = jnp.minimum(jnp.min(bounds, axis=-1), vals[:, m + 1])
 
     # kernel-padding rows carry real-looking indices in [rows, padded);
     # clip-gathering them would hand a PAD candidate the LAST REAL row's
@@ -463,6 +529,9 @@ def knn_search_pallas(
     margin: int = 28,
     tile_n: int = TILE_N,
     precision: str = "bf16x3",
+    bin_w: Optional[int] = None,
+    survivors: Optional[int] = None,
+    final_select: str = "exact",
 ) -> Tuple[np.ndarray, np.ndarray, dict]:
     """Certified-exact KNN in ONE database pass on a single-device mesh:
     fused kernel coarse select -> device rank -> exclusion-bound
@@ -487,6 +556,7 @@ def knn_search_pallas(
     return prog.search_certified(
         np.asarray(queries, dtype=np.float32), margin=margin,
         selector="pallas", tile_n=tile_n, precision=precision,
+        bin_w=bin_w, survivors=survivors, final_select=final_select,
     )
 
 

@@ -81,6 +81,35 @@ def _allgather_merge(d, i, k: int, axis_name: str):
     return topk_pairs(ad, ai, k)
 
 
+def _pack_bits_u32(mask: jax.Array) -> jax.Array:
+    """[Q, B] bool -> [Q, ceil(B/32)] uint32, bit j of word w = column
+    32*w + j.  Shrinks the near-tie mask's device->host transfer 32x —
+    through the dev harness's ~12 MB/s relay that is wall-clock, not
+    tidiness."""
+    n_q, b = mask.shape
+    nw = -(-b // 32)
+    padded = jnp.pad(mask.astype(jnp.uint32), ((0, 0), (0, nw * 32 - b)))
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(padded.reshape(n_q, nw, 32) * weights, axis=-1,
+                   dtype=jnp.uint32)
+
+
+def unpack_bits_u32(words: np.ndarray, n_bits: int) -> np.ndarray:
+    """Host inverse of :func:`_pack_bits_u32`: [Q, nw] uint32 -> [Q,
+    n_bits] bool."""
+    w = np.asarray(words, dtype=np.uint32)
+    bits = (w[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    return bits.reshape(w.shape[0], -1)[:, :n_bits].astype(bool)
+
+
+def _analysis_window(k: int, m: int) -> int:
+    """Width of the device rank-analysis window: the packed program
+    output's column layout, _certify_pallas's unpack, and bench.py's
+    phase breakdown all derive from THIS — one home, or unpack_certified
+    silently slices shifted columns."""
+    return min(k + 17, m + 1)
+
+
 _MERGES = ("allgather", "ring")
 
 #: Certified-path coarse selectors.  "exact" ranks every row (float32
@@ -91,7 +120,8 @@ _MERGES = ("allgather", "ring")
 SELECTORS = ("exact", "approx", "pallas")
 
 
-def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector):
+def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector,
+                recall_target=None):
     """Local shard top-k with global train indices.
 
     The last db shard may contain zero-padding rows; their distances are
@@ -110,8 +140,9 @@ def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector):
     elif selector == "approx":
         from knn_tpu.ops.topk import knn_search_approx
 
+        kw = {} if recall_target is None else {"recall_target": recall_target}
         d, i = knn_search_approx(
-            q, t, k, compute_dtype=compute_dtype, n_valid=n_local_valid
+            q, t, k, compute_dtype=compute_dtype, n_valid=n_local_valid, **kw
         )
     else:
         raise ValueError(f"unknown selector {selector!r}; expected one of {SELECTORS}")
@@ -121,9 +152,10 @@ def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector):
 
 
 def _merged_topk(q, t, k, metric, merge, n_train, train_tile, compute_dtype,
-                 db_shards, selector="exact"):
+                 db_shards, selector="exact", recall_target=None):
     """Shared SPMD body: local shard top-k, then merge across the db axis."""
-    d, gi = _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector)
+    d, gi = _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype,
+                        selector, recall_target)
     if db_shards > 1:
         if merge == "ring":
             d, gi = _ring_merge(d, gi, k, DB_AXIS, db_shards)
@@ -142,13 +174,14 @@ def _knn_program(
     train_tile: Optional[int],
     compute_dtype,
     selector: str = "exact",
+    recall_target: Optional[float] = None,
 ):
     db_shards = mesh.shape[DB_AXIS]
 
     def spmd(q, t):
         return _merged_topk(
             q, t, k, metric, merge, n_train, train_tile, compute_dtype,
-            db_shards, selector,
+            db_shards, selector, recall_target,
         )
 
     return jax.jit(
@@ -316,6 +349,9 @@ class ShardedKNN:
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None, tile_n: Optional[int] = None,
         precision: str = "bf16x3", return_distances: bool = True,
+        bin_w: Optional[int] = None, survivors: Optional[int] = None,
+        block_q: Optional[int] = None, final_select: str = "exact",
+        recall_target: Optional[float] = None,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
         Returns (dists_f64, idx, stats).  L2 only (the certificate is a
@@ -347,8 +383,20 @@ class ShardedKNN:
         ``batch_size`` streams the queries in fixed-size batches with the
         device stages pipelined against the host stages: every batch's
         coarse select is dispatched up front (one compiled shape), so the
-        host refine of batch b overlaps the device work of batches > b.
-        None = one batch (all queries at once).
+        host refine / device->host transfer of batch b overlaps the
+        device work of batches > b.  None = one batch (all queries at
+        once).
+
+        Pallas-selector tuning knobs (defaults = measured v5e winners):
+        ``bin_w`` (lane width of a kernel bin), ``survivors`` (candidates
+        kept per bin; the candidate array the final select scans is
+        ``~ n_rows * survivors / bin_w`` wide), ``final_select``
+        ("exact" = full top-(m+2) | "approx" = hardware ApproxTopK with
+        the exclusion value restored exactly — cheaper, never unsound,
+        misses surface as fallbacks).  ``recall_target`` tunes the
+        counted "approx" selector's per-element ApproxTopK recall
+        (None = its default 0.95; raise toward 0.9999 with a wider
+        ``margin`` to push the fallback rate below 1%).
         """
         if self.metric not in ("l2", "sql2", "euclidean"):
             raise ValueError("search_certified supports the l2 metric only")
@@ -387,10 +435,13 @@ class ShardedKNN:
                 batches, bs, m, d, i, q_np, db_np, db_norm_max,
                 tile_n=tile_n, precision=precision,
                 want_distances=return_distances,
+                bin_w=bin_w, survivors=survivors, block_q=block_q,
+                final_select=final_select,
             )
         else:
             bad = self._certify_counted(
-                batches, bs, m, d, i, q_np, db_np, db_norm_max, selector
+                batches, bs, m, d, i, q_np, db_np, db_norm_max, selector,
+                recall_target=recall_target,
             )
 
         def _select(qb, widen):
@@ -424,18 +475,34 @@ class ShardedKNN:
         return (d if return_distances else None), i, stats
 
     def _certify_counted(
-        self, batches, bs, m, d, i, q_np, db_np, db_norm_max, selector
+        self, batches, bs, m, d, i, q_np, db_np, db_norm_max, selector,
+        recall_target: Optional[float] = None,
     ):
         """Two-pass certificate: coarse select + refine, then the
         distributed count-below program proves completeness.  Returns the
-        flagged query indices."""
+        flagged query indices.
+
+        The count threshold is ADAPTIVE: the refine already produced the
+        float64 distances of every candidate, so each query counts
+        against the midpoint of the first inter-neighbor gap at rank
+        j >= k that exceeds twice the count pass's float32 tolerance
+        (count <= j proves no outsider sits at or below the j-th
+        candidate, and ranks <= j are float64-refined).  The fixed
+        ``d_k + tol`` threshold false-alarmed whenever ANY point sat
+        within tol of d_k — at SIFT1M scale ~2.4% of queries
+        (TUNING_r03: 100/4096 fallbacks, all false alarms at
+        recall_target 0.9999); a gap beyond which the midpoint clears
+        tol almost always exists inside the margin window, so the
+        adaptive form certifies those queries instead."""
         from knn_tpu.ops.certified import certification_tolerance
         from knn_tpu.ops.refine import refine_exact
 
         n_q = q_np.shape[0]
+        k = self.k
         coarse = _knn_program(
             self.mesh, m, self.metric, self.merge, self.n_train,
             self.train_tile, self._dtype_key, selector,
+            recall_target=recall_target,
         )
         count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
 
@@ -451,30 +518,63 @@ class ShardedKNN:
         for (lo, chunk, pad), (qp, (_, ci)) in zip(batches, coarse_out):
             take = bs - pad
             ci = np.asarray(ci)[:take]
-            d_b, i_b = refine_exact(db_np, q_np[lo : lo + take], ci, self.k)
+            m_avail = ci.shape[1]
+            # refine ALL candidates: ranks k..m feed the gap search
+            d_m, i_m = refine_exact(db_np, q_np[lo : lo + take], ci, m_avail)
+            d_b, i_b = d_m[:, :k], i_m[:, :k]
             d[lo : lo + take], i[lo : lo + take] = d_b, i_b
-            thr = d_b[:, self.k - 1] + certification_tolerance(
+            tol = certification_tolerance(
                 q_np[lo : lo + take], db_np, db_norm_max=db_norm_max
             )
+            # first rank j in [k, m_avail) whose gap d[j] - d[j-1]
+            # exceeds 2*tol (js = that j, or k when none does — the
+            # fixed-threshold behavior)
+            gaps = d_m[:, k:] - d_m[:, k - 1 : -1]  # [take, m_avail - k]
+            # the midpoint is cast to f32 for the count program: demand
+            # the gap also clear that rounding, and never use a gap to a
+            # sentinel (+inf) rank
+            f32_round = 4.0 * float(np.finfo(np.float32).eps) * np.abs(
+                d_m[:, k:])
+            open_gap = (gaps > 2.0 * tol[:, None] + f32_round) & np.isfinite(
+                d_m[:, k:])
+            if open_gap.shape[1] == 0:  # m == k: no window, fixed threshold
+                has = np.zeros(take, dtype=bool)
+                js = np.full(take, k)
+            else:
+                has = open_gap.any(axis=-1)
+                js = np.where(has, k + open_gap.argmax(axis=-1), k)
+            dj = np.take_along_axis(d_m, js[:, None] - 1, axis=-1)[:, 0]
+            # js == m_avail only when has is False (np.where evaluates
+            # both branches): clip the gather, the fixed arm wins anyway
+            d_js = np.take_along_axis(
+                d_m, np.minimum(js, m_avail - 1)[:, None], axis=-1
+            )[:, 0]
+            mid = np.where(has, 0.5 * (dj + d_js), dj + tol)
             thr_p = np.full(qp.shape[0], -np.inf, dtype=np.float32)
-            thr_p[:take] = thr
-            count_out.append(
-                (lo, take, count_fn(qp, self._tp, shard(thr_p, self.mesh, QUERY_AXIS)))
-            )
+            thr_p[:take] = mid
+            count_out.append((
+                lo, take, js,
+                count_fn(qp, self._tp, shard(thr_p, self.mesh, QUERY_AXIS)),
+            ))
 
-        # stage 3: collect certificates
-        counts = np.empty(n_q, dtype=np.int64)
-        for lo, take, c in count_out:
-            counts[lo : lo + take] = np.asarray(c)[:take]
-        return np.flatnonzero(counts > self.k)
+        # stage 3: collect certificates (count <= per-query rank bound)
+        flagged = []
+        for lo, take, js, c in count_out:
+            over = np.asarray(c)[:take] > js
+            flagged.append(lo + np.flatnonzero(over))
+        return np.concatenate(flagged) if flagged else np.empty(0, np.int64)
 
     def _pallas_setup(self, margin: int, tile_n: Optional[int],
-                      precision: str):
+                      precision: str, bin_w: Optional[int] = None,
+                      survivors: Optional[int] = None,
+                      block_q: Optional[int] = None,
+                      final_select: str = "exact",
+                      include_distances: bool = True):
         """(program, m) for the one-pass certified path — the ONE home of
         the kernel-geometry margin cap, shared by :meth:`_certify_pallas`
         and bench.py's phase breakdown so they can never measure
         different programs."""
-        from knn_tpu.ops.pallas_knn import BIN_W, TILE_N
+        from knn_tpu.ops.pallas_knn import BIN_W, TILE_N, _geometry
 
         if precision not in ("bf16x3", "highest"):
             # "default" has no certified tolerance model (its matmul error
@@ -485,14 +585,16 @@ class ShardedKNN:
                 f"model; use 'bf16x3' or 'highest'"
             )
 
+        eff_bin = bin_w or BIN_W
         shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
         eff_tile = min(tile_n or TILE_N,
-                       max(BIN_W, -(-shard_rows // BIN_W) * BIN_W))
+                       max(eff_bin, -(-shard_rows // eff_bin) * eff_bin))
+        _, _, out_w, _ = _geometry(eff_tile, eff_bin, survivors)
         # m is bounded by the db, the per-shard rows, and the kernel's
         # per-shard candidate width minus the two slots the exclusion
         # value needs (ops.pallas_knn.local_certified_candidates)
         m = min(self.k + margin, self.n_train, shard_rows,
-                -(-shard_rows // eff_tile) * 128 - 2)
+                -(-shard_rows // eff_tile) * out_w - 2)
         if m <= self.k:
             raise ValueError(
                 f"pallas selector: margin headroom m={m} <= k={self.k} on "
@@ -501,26 +603,33 @@ class ShardedKNN:
             )
         prog = _pallas_certified_program(
             self.mesh, m, self.k, self.merge, tile_n, precision,
-            n_train=self.n_train,
+            n_train=self.n_train, bin_w=bin_w, survivors=survivors,
+            block_q=block_q, final_select=final_select,
+            include_distances=include_distances,
         )
-        return prog, m
+        return prog, m, _analysis_window(self.k, m)
 
     def _certify_pallas(
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, *,
-        tile_n, precision, want_distances=True,
+        tile_n, precision, want_distances=True, bin_w=None, survivors=None,
+        block_q=None, final_select="exact",
     ):
         """One-pass certificate, host side.  The device already ranked the
         candidates, flagged uncertified rows, and marked near-tie pairs
-        (_pallas_certified_program); the host fetches ONLY indices, the
-        tight-pair mask, and the bad flags (plus the top-k distance block
-        when ``want_distances``) — the [Q, m+1] score matrix never crosses
-        the slow device->host link — then repairs tie runs in float64
-        (ops.refine.rank_correct_runs).  Returns (flagged query indices,
-        rank-corrected query count)."""
+        (_pallas_certified_program); the host fetches ONLY the windowed
+        indices, the bit-packed tight-pair mask, and the bad flags (plus
+        the top-k distance block when ``want_distances``) — nothing wider
+        crosses the slow device->host link — then repairs tie runs in
+        float64 (ops.refine.rank_correct_runs).  Returns (flagged query
+        indices, rank-corrected query count)."""
         from knn_tpu.ops.refine import rank_correct_runs
 
         k = self.k
-        prog, m = self._pallas_setup(m - self.k, tile_n, precision)
+        prog, m, w = self._pallas_setup(m - self.k, tile_n, precision,
+                                        bin_w=bin_w, survivors=survivors,
+                                        block_q=block_q,
+                                        final_select=final_select,
+                                        include_distances=want_distances)
 
         # stage 1: dispatch every batch (async on device)
         norm_op = np.float32(db_norm_max)
@@ -529,23 +638,24 @@ class ShardedKNN:
             qp, _ = self._place_queries(chunk)
             outs.append(prog(qp, self._tp, norm_op))
 
-        # stage 2: per batch — fetch the small outputs, repair tie runs
+        # stage 2: per batch — ONE fetch of the packed output (the relay
+        # charges a fixed latency per transfer), then repair tie runs
         bad_mask = np.zeros(q_np.shape[0], dtype=bool)
         n_corrected = 0
-        for (lo, chunk, pad), (d32, gi, tight, bad) in zip(batches, outs):
+        for (lo, chunk, pad), packed in zip(batches, outs):
             take = bs - pad
-            gi_np = np.asarray(gi)[:take]
-            tight_np = np.asarray(tight)[:take].astype(bool)
-            dk = (np.asarray(d32[:, :k])[:take].astype(np.float64)
-                  if want_distances else None)
+            gi_np, tight_np, bad_np, dk_np = unpack_certified(
+                np.asarray(packed)[:take], k, w, want_distances
+            )
             dc, ic, n_c = rank_correct_runs(
-                gi_np, tight_np, k, q_np[lo : lo + take], db_np, d32k=dk
+                gi_np, tight_np, k, q_np[lo : lo + take], db_np,
+                d32k=None if dk_np is None else dk_np.astype(np.float64),
             )
             n_corrected += n_c
             if dc is not None:
                 d[lo : lo + take] = dc
             i[lo : lo + take] = ic
-            bad_mask[lo : lo + take] = np.asarray(bad)[:take].astype(bool)
+            bad_mask[lo : lo + take] = bad_np
         return np.flatnonzero(bad_mask), n_corrected
 
     def predict_certified(
@@ -666,6 +776,9 @@ def sharded_knn_predict(
 def _pallas_certified_program(
     mesh: Mesh, m: int, k: int, merge: str, tile_n: Optional[int],
     precision: str, n_train: Optional[int] = None,
+    bin_w: Optional[int] = None, survivors: Optional[int] = None,
+    block_q: Optional[int] = None, final_select: str = "exact",
+    include_distances: bool = True,
 ):
     """ONE-pass sharded self-certifying coarse select + device rank +
     device certificate (ops.pallas_knn.local_certified_candidates per
@@ -673,26 +786,32 @@ def _pallas_certified_program(
     in lexicographic order, merged across the db axis (ring/allgather as
     usual) while the kernel-space exclusion bounds pmin.
 
-    The certificate and the near-tie analysis run ON DEVICE so the host
-    only fetches what it uses — through a slow device->host link (the dev
-    harness relay moves ~13 MB/s) the [Q, m+1] f32 score matrix would
-    otherwise dominate the sweep.  Program outputs:
+    The certificate and the near-tie analysis run ON DEVICE, and every
+    host-facing output is packed into ONE int32 array — the dev
+    harness's device->host relay charges ~65 ms latency PER FETCH on
+    top of ~19 MB/s, so one call for one [Q, W + nw + 1 (+ k)] array
+    beats four small ones by several fixed latencies per sweep.  Packed
+    columns (see ``unpack_certified`` for the host-side inverse):
 
-      d32   [Q, m+1] f32   ranked direct-difference distances (fetched
-                           only when the caller wants distance values),
-      gi    [Q, m+1] i32   their global db row indices,
-      tight [Q, W-1] i8    near-tie mask over the analysis window W =
-                           min(k+17, m+1): pair j is 1 when positions
-                           j, j+1 are closer than RANK_SLACK and sit
-                           before the top-k set boundary's first big gap,
-      bad   [Q]      i8    uncertified OR boundary-unresolvable rows
-                           (repair reruns them exactly).
+      [0, W)            i32   ranked global db row indices over the
+                              analysis window W = min(k+17, m+1),
+      [W, W+nw)         u32-bits  near-tie mask, bit-packed: bit j is 1
+                              when positions j, j+1 are closer than
+                              RANK_SLACK and sit before the top-k set
+                              boundary's first big gap,
+      [W+nw]            i32   bad flag: uncertified OR boundary-
+                              unresolvable rows (repair reruns exactly),
+      [W+nw+1, +k)      f32-bitcast  ranked direct-difference top-k
+                              distances (``include_distances`` only —
+                              label/index consumers skip the columns).
 
     Soundness: a db row outside the candidates has kernel score >= lb,
     or was merge-dropped with direct distance >= d32[:, m]; ``bad`` is
     the union of both checks plus rows whose tie run crosses the
     analysis window (no provable top-k boundary)."""
     from knn_tpu.ops.pallas_knn import (
+        BIN_W,
+        BLOCK_Q,
         RANK_SLACK,
         TILE_N,
         local_certified_candidates,
@@ -700,11 +819,14 @@ def _pallas_certified_program(
 
     db_shards = mesh.shape[DB_AXIS]
     eff_tile = tile_n or TILE_N
-    w = min(k + 17, m + 1)
+    eff_bin = bin_w or BIN_W
+    eff_bq = block_q or BLOCK_Q
+    w = _analysis_window(k, m)
 
     def spmd(q, t, db_norm_max):
         d32, li, lb = local_certified_candidates(
-            q, t, m, tile_n=eff_tile, precision=precision
+            q, t, m, tile_n=eff_tile, bin_w=eff_bin, survivors=survivors,
+            block_q=eff_bq, final_select=final_select, precision=precision,
         )
         db_idx = lax.axis_index(DB_AXIS)
         gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,
@@ -759,19 +881,43 @@ def _pallas_certified_program(
             bad = bad | (d_k + RANK_SLACK * d_k
                          >= d32[:, m] * (1.0 - RANK_SLACK))
         bad = bad | unresolved
-        return (d32, gi, tight_use.astype(jnp.int8),
-                bad.astype(jnp.int8))
+        cols = [
+            gi[:, :w],
+            lax.bitcast_convert_type(_pack_bits_u32(tight_use), jnp.int32),
+            bad.astype(jnp.int32)[:, None],
+        ]
+        if include_distances:
+            cols.append(lax.bitcast_convert_type(d32[:, :k], jnp.int32))
+        return jnp.concatenate(cols, axis=1)
 
     return jax.jit(
         jax.shard_map(
             spmd,
             mesh=mesh,
             in_specs=(P(QUERY_AXIS), P(DB_AXIS), P()),
-            out_specs=(P(QUERY_AXIS), P(QUERY_AXIS), P(QUERY_AXIS),
-                       P(QUERY_AXIS)),
+            out_specs=P(QUERY_AXIS),
             check_vma=False,
         )
     )
+
+
+def unpack_certified(
+    packed: np.ndarray, k: int, w: int, with_distances: bool
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Host inverse of ``_pallas_certified_program``'s packed output:
+    (gi [Q, w] i32, tight [Q, w-1] bool, bad [Q] bool, dk [Q, k] f32 or
+    None)."""
+    arr = np.ascontiguousarray(np.asarray(packed))
+    nw = -(-(w - 1) // 32)
+    gi = arr[:, :w]
+    tight = unpack_bits_u32(arr[:, w : w + nw].view(np.uint32), w - 1)
+    bad = arr[:, w + nw] != 0
+    dk = None
+    if with_distances:
+        dk = np.ascontiguousarray(
+            arr[:, w + nw + 1 : w + nw + 1 + k]
+        ).view(np.float32)
+    return gi, tight, bad, dk
 
 
 @functools.lru_cache(maxsize=32)

@@ -1,0 +1,196 @@
+"""Round-3 TPU tuning session: pick the production geometry for the
+fused certified kernel, the final-select strategy, the pallas sweep batch
+size, and the certified_approx (margin, recall_target) calibration.
+
+Appends one JSON line per measurement to TUNING_r03.jsonl so a crash
+mid-session still leaves everything measured so far.  Scratch: results
+feed defaults in ops/pallas_knn.py + bench.py, not shipped behavior.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "TUNING_r03.jsonl")
+
+
+def emit(**kw):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(kw) + "\n")
+    print(json.dumps(kw), flush=True)
+
+
+t_start = time.time()
+
+
+def log(msg):
+    print(f"[tune +{time.time()-t_start:.0f}s] {msg}", flush=True)
+
+
+log("importing jax / acquiring device claim ...")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+log(f"devices: {jax.devices()} backend={jax.default_backend()}")
+
+from knn_tpu.ops.pallas_knn import _bin_candidates, local_certified_candidates  # noqa: E402
+from knn_tpu.parallel.mesh import make_mesh  # noqa: E402
+from knn_tpu.parallel.sharded import ShardedKNN  # noqa: E402
+
+N, DIM, K, NQ = 1_000_000, 128, 100, 4096
+rng = np.random.default_rng(0)
+db = (rng.random(size=(N, DIM)) * 128.0).astype(np.float32)
+queries = (rng.random(size=(NQ, DIM)) * 128.0).astype(np.float32)
+dbj = jax.device_put(jnp.asarray(db))
+qj = jax.device_put(jnp.asarray(queries))
+
+# ---------------------------------------------------------------- 1. d2h
+log("d2h bandwidth probe ...")
+for mb in (0.125, 0.5, 2.0, 8.0):
+    n_el = int(mb * 1e6 / 4)
+    x = jnp.ones((n_el,), jnp.float32) * 2.0
+    jax.block_until_ready(x)
+    np.asarray(x[:16])
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(x)
+        ts.append(time.perf_counter() - t0)
+    t = min(ts)
+    emit(probe="d2h", mb=mb, s=round(t, 4), mbps=round(mb / t, 1))
+
+# ------------------------------------------------- 2. kernel-only grid
+GRID = [
+    # (block_q, tile_n, bin_w, survivors, precision)
+    (128, 8192, 128, 2, "bf16x3"),    # current production default
+    (256, 8192, 128, 2, "bf16x3"),
+    (128, 16384, 128, 2, "bf16x3"),   # out_w=256, half the cells
+    (256, 16384, 128, 2, "bf16x3"),
+    (128, 16384, 256, 2, "bf16x3"),   # candidate width halves -> 7936
+    (128, 32768, 256, 3, "bf16x3"),   # width 11904, triple-collision safe
+    (256, 32768, 256, 3, "bf16x3"),
+    (128, 8192, 128, 2, "highest"),
+    (128, 16384, 256, 2, "highest"),
+]
+
+
+def time_kernel(bq, tn, bw, sv, prec, nb=8):
+    def launch(i):
+        return _bin_candidates(
+            qj[i * 512:(i + 1) * 512], dbj, block_q=bq, tile_n=tn,
+            bin_w=bw, survivors=sv, precision=prec, interpret=False,
+        )
+    out = launch(0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = [launch(i % 8) for i in range(nb)]
+    jax.block_until_ready(outs[-1])
+    return (time.perf_counter() - t0) / nb
+
+
+for bq, tn, bw, sv, prec in GRID:
+    try:
+        dt = time_kernel(bq, tn, bw, sv, prec)
+        emit(probe="kernel", block_q=bq, tile_n=tn, bin_w=bw, survivors=sv,
+             precision=prec, ms_per_b512=round(dt * 1e3, 2),
+             ms_per_4096=round(dt * 8e3, 1))
+    except Exception as e:
+        emit(probe="kernel", block_q=bq, tile_n=tn, bin_w=bw, survivors=sv,
+             precision=prec, error=str(e)[:200])
+
+# --------------------------------- 3. full local candidates (+select)
+LGRID = [
+    (128, 8192, 128, 2, "exact"),
+    (128, 16384, 256, 2, "exact"),
+    (128, 16384, 256, 2, "approx"),
+    (128, 32768, 256, 3, "exact"),
+    (128, 32768, 256, 3, "approx"),
+    (128, 8192, 128, 2, "approx"),
+]
+M = K + 28
+
+
+def time_local(bq, tn, bw, sv, fs, nb=8):
+    def launch(i):
+        return local_certified_candidates(
+            qj[i * 512:(i + 1) * 512], dbj, m=M, block_q=bq, tile_n=tn,
+            bin_w=bw, survivors=sv, final_select=fs, interpret=False,
+        )
+    out = launch(0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    outs = [launch(i % 8) for i in range(nb)]
+    jax.block_until_ready(outs[-1])
+    return (time.perf_counter() - t0) / nb
+
+
+for bq, tn, bw, sv, fs in LGRID:
+    try:
+        dt = time_local(bq, tn, bw, sv, fs)
+        emit(probe="local_full", block_q=bq, tile_n=tn, bin_w=bw,
+             survivors=sv, final_select=fs,
+             ms_per_b512=round(dt * 1e3, 2), ms_per_4096=round(dt * 8e3, 1))
+    except Exception as e:
+        emit(probe="local_full", block_q=bq, tile_n=tn, bin_w=bw,
+             survivors=sv, final_select=fs, error=str(e)[:200])
+
+# -------------------- 4. end-to-end certified pallas: best configs
+mesh = make_mesh()
+prog = ShardedKNN(db, mesh=mesh, k=K, metric="l2", train_tile=131072,
+                  compute_dtype="bfloat16")
+
+E2E = [
+    # (tile_n, bin_w, survivors, final_select, batch_size, want_d)
+    (None, None, None, "exact", None, True),      # round-2 production
+    (16384, 256, 2, "exact", None, True),
+    (16384, 256, 2, "approx", None, True),
+    (32768, 256, 3, "approx", None, True),
+    (32768, 256, 3, "approx", 1024, True),
+    (32768, 256, 3, "approx", 512, True),
+    (32768, 256, 3, "approx", 1024, False),
+    (16384, 256, 2, "approx", 1024, False),
+]
+for tn, bw, sv, fs, bsz, wd in E2E:
+    try:
+        kw = dict(margin=28, selector="pallas", batch_size=bsz, tile_n=tn,
+                  bin_w=bw, survivors=sv, final_select=fs,
+                  return_distances=wd)
+        prog.search_certified(queries, **kw)  # warm/compile the real shape
+        ts = []
+        st = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _, _, st = prog.search_certified(queries, **kw)
+            ts.append(time.perf_counter() - t0)
+        t = float(np.mean(ts))
+        emit(probe="e2e_pallas", tile_n=tn, bin_w=bw, survivors=sv,
+             final_select=fs, batch=bsz, distances=wd,
+             s_mean=round(t, 4), qps=round(NQ / t, 1), stats=st)
+    except Exception as e:
+        emit(probe="e2e_pallas", tile_n=tn, bin_w=bw, survivors=sv,
+             final_select=fs, batch=bsz, distances=wd, error=str(e)[:200])
+
+# ---------------------- 5. certified_approx (margin, rt) calibration
+for margin, rt in ((128, 0.99), (412, 0.99), (412, 0.9999), (156, 0.9999)):
+    try:
+        kw = dict(margin=margin, selector="approx", batch_size=512,
+                  recall_target=rt)
+        prog.search_certified(queries, **kw)
+        ts = []
+        st = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            _, _, st = prog.search_certified(queries, **kw)
+            ts.append(time.perf_counter() - t0)
+        t = float(np.mean(ts))
+        emit(probe="approx_cal", margin=margin, recall_target=rt,
+             s_mean=round(t, 4), qps=round(NQ / t, 1), stats=st)
+    except Exception as e:
+        emit(probe="approx_cal", margin=margin, recall_target=rt,
+             error=str(e)[:200])
+
+log("tuning session done")

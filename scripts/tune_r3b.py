@@ -1,0 +1,215 @@
+"""Round-3 follow-up tuning: block_q sweep on the winning geometry
+(tile_n=8192, bin_w=128, survivors=2 — the wider-tile/wider-bin variants
+measured SLOWER in-kernel than the candidate-width saving was worth),
+final_select=approx fallback safety, batch pipelining, and an honest d2h
+probe (fresh arrays per rep: np.asarray caches on the jax.Array, which
+made the first probe report TB/s).  Appends to TUNING_r03.jsonl."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "TUNING_r03.jsonl")
+
+
+def emit(**kw):
+    with open(OUT, "a") as f:
+        f.write(json.dumps(kw) + "\n")
+    print(json.dumps(kw), flush=True)
+
+
+t_start = time.time()
+
+
+def log(msg):
+    print(f"[tune_b +{time.time()-t_start:.0f}s] {msg}", flush=True)
+
+
+log("importing jax / acquiring device claim ...")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+log(f"devices: {jax.devices()} backend={jax.default_backend()}")
+
+from knn_tpu.ops.pallas_knn import _bin_candidates, local_certified_candidates  # noqa: E402
+from knn_tpu.parallel.mesh import make_mesh  # noqa: E402
+from knn_tpu.parallel.sharded import ShardedKNN  # noqa: E402
+
+N, DIM, K, NQ = 1_000_000, 128, 100, 4096
+rng = np.random.default_rng(0)
+db = (rng.random(size=(N, DIM)) * 128.0).astype(np.float32)
+queries = (rng.random(size=(NQ, DIM)) * 128.0).astype(np.float32)
+dbj = jax.device_put(jnp.asarray(db))
+qj = jax.device_put(jnp.asarray(queries))
+
+# -------------------------------------------- 1. honest d2h bandwidth
+log("d2h probe (fresh arrays) ...")
+for mb in (0.25, 1.0, 4.0):
+    n_el = int(mb * 1e6 / 4)
+    xs = [jnp.arange(i, n_el + i, dtype=jnp.int32) for i in range(4)]
+    jax.block_until_ready(xs)
+    np.asarray(xs[0])  # first-transfer warm (lazy relay setup)
+    ts = []
+    for x in xs[1:]:
+        t0 = time.perf_counter()
+        np.asarray(x)
+        ts.append(time.perf_counter() - t0)
+    t = min(ts)
+    emit(probe="d2h_fresh", mb=mb, s=round(t, 4), mbps=round(mb / t, 1))
+
+# ------------------------------- 2. block_q sweep, winning geometry
+for bq in (32, 64, 128):
+    def launch(i, bq=bq):
+        return _bin_candidates(
+            qj[i * 512:(i + 1) * 512], dbj, block_q=bq, tile_n=8192,
+            bin_w=128, survivors=2, precision="bf16x3", interpret=False,
+        )
+    try:
+        out = launch(0)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = [launch(i % 8) for i in range(8)]
+        jax.block_until_ready(outs[-1])
+        dt = (time.perf_counter() - t0) / 8
+        emit(probe="kernel_bq", block_q=bq, ms_per_b512=round(dt * 1e3, 2),
+             ms_per_4096=round(dt * 8e3, 1))
+    except Exception as e:
+        emit(probe="kernel_bq", block_q=bq, error=str(e)[:200])
+
+# one full-size launch (the production batch shape): grid amortization
+for bq in (64, 128):
+    try:
+        out = _bin_candidates(qj, dbj, block_q=bq, tile_n=8192, bin_w=128,
+                              survivors=2, precision="bf16x3",
+                              interpret=False)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = _bin_candidates(qj, dbj, block_q=bq, tile_n=8192,
+                                  bin_w=128, survivors=2,
+                                  precision="bf16x3", interpret=False)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        emit(probe="kernel_full4096", block_q=bq,
+             ms_per_4096=round(min(ts) * 1e3, 1))
+    except Exception as e:
+        emit(probe="kernel_full4096", block_q=bq, error=str(e)[:200])
+
+# ---------------------- 3. local candidates full, winning geometry
+M = K + 28
+for bq, fs in ((64, "exact"), (64, "approx"), (128, "approx")):
+    def launch(i, bq=bq, fs=fs):
+        return local_certified_candidates(
+            qj[i * 512:(i + 1) * 512], dbj, m=M, block_q=bq, tile_n=8192,
+            bin_w=128, survivors=2, final_select=fs, interpret=False,
+        )
+    try:
+        out = launch(0)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        outs = [launch(i % 8) for i in range(8)]
+        jax.block_until_ready(outs[-1])
+        dt = (time.perf_counter() - t0) / 8
+        emit(probe="local_bq", block_q=bq, final_select=fs,
+             ms_per_b512=round(dt * 1e3, 2), ms_per_4096=round(dt * 8e3, 1))
+    except Exception as e:
+        emit(probe="local_bq", block_q=bq, final_select=fs,
+             error=str(e)[:200])
+
+# ----------------------------------------------- 4. h2d upload probe
+for mb in (0.5, 2.0):
+    n_el = int(mb * 1e6 / 4)
+    hosts = [np.arange(i, n_el + i, dtype=np.float32) for i in range(4)]
+    x = jax.device_put(hosts[0])
+    jax.block_until_ready(x)
+    ts = []
+    for h in hosts[1:]:
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(h))
+        ts.append(time.perf_counter() - t0)
+    t = min(ts)
+    emit(probe="h2d_fresh", mb=mb, s=round(t, 4), mbps=round(mb / t, 1))
+
+# -------------------- 5. e2e phase budget at the default geometry
+mesh = make_mesh()
+prog = ShardedKNN(db, mesh=mesh, k=K, metric="l2", train_tile=131072,
+                  compute_dtype="bfloat16")
+
+for bq, fs in ((None, "exact"), (64, "exact"), (64, "approx")):
+    try:
+        pp, m = prog._pallas_setup(28, None, "bf16x3", block_q=bq,
+                                   final_select=fs)
+        w = min(K + 17, m + 1)
+        qp, _ = prog._place_queries(queries)
+        norm_op = np.float32(prog._db_norm_max())
+        out = pp(qp, prog._tp, norm_op)
+        jax.block_until_ready(out)
+
+        # (a) query h2d placement alone
+        t0 = time.perf_counter()
+        qp2, _ = prog._place_queries(queries)
+        jax.block_until_ready(qp2)
+        t_h2d = time.perf_counter() - t0
+        # (b) device compute alone (no fetch)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = pp(qp, prog._tp, norm_op)
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        t_dev = min(ts)
+        # (c) fetches, itemized
+        t0 = time.perf_counter()
+        gi = np.asarray(out[1])
+        t_gi = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        dk = np.asarray(out[0])
+        t_dk = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        bits = np.asarray(out[2])
+        badf = np.asarray(out[3])
+        t_rest = time.perf_counter() - t0
+        emit(probe="phase_budget", block_q=bq, final_select=fs,
+             h2d_queries_s=round(t_h2d, 4), device_s=round(t_dev, 4),
+             fetch_gi_s=round(t_gi, 4), fetch_dk_s=round(t_dk, 4),
+             fetch_rest_s=round(t_rest, 4),
+             gi_mb=round(gi.nbytes / 1e6, 2),
+             dk_mb=round(dk.nbytes / 1e6, 2),
+             device_qps=round(NQ / t_dev, 1))
+    except Exception as e:
+        emit(probe="phase_budget", block_q=bq, final_select=fs,
+             error=str(e)[:200])
+
+# ------------------------- 6. e2e sweeps (one batch proven best)
+E2E = [
+    # (block_q, final_select, batch_size, want_d)
+    (None, "approx", None, True),
+    (64, "approx", None, True),
+    (64, "approx", None, False),
+    (64, "exact", None, False),
+]
+for bq, fs, bsz, wd in E2E:
+    try:
+        kw = dict(margin=28, selector="pallas", batch_size=bsz,
+                  block_q=bq, final_select=fs, return_distances=wd)
+        prog.search_certified(queries, **kw)
+        ts = []
+        st = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _, _, st = prog.search_certified(queries, **kw)
+            ts.append(time.perf_counter() - t0)
+        t = float(np.mean(ts))
+        emit(probe="e2e_b", block_q=bq, final_select=fs, batch=bsz,
+             distances=wd, s_mean=round(t, 4), qps=round(NQ / t, 1),
+             stats=st)
+    except Exception as e:
+        emit(probe="e2e_b", block_q=bq, final_select=fs, batch=bsz,
+             distances=wd, error=str(e)[:200])
+
+log("follow-up tuning done")

@@ -214,3 +214,76 @@ def test_candidate_fn_composition_on_tiny_db(rng):
     )
     np.testing.assert_array_equal(i, ref_i)
     np.testing.assert_allclose(d, ref_d, rtol=1e-9)
+
+
+@pytest.mark.parametrize("bin_w,survivors", [(2 * BIN_W, 3), (2 * BIN_W, 2),
+                                             (BIN_W, 4)])
+def test_wide_bin_geometry_matches_oracle(rng, bin_w, survivors):
+    # the tunable geometry (wider bins x more survivors shrinks the
+    # candidate array the final select scans): certified exactness must
+    # hold for every (bin_w, survivors) the bench sweeps
+    db = rng.normal(size=(9 * BIN_W + 45, 16)).astype(np.float32) * 20
+    queries = rng.normal(size=(11, 16)).astype(np.float32) * 20
+    ref_d, ref_i = _oracle(db, queries, 7)
+    d, i, stats = knn_search_pallas(
+        queries, db, 7, tile_n=4 * BIN_W, margin=8, bin_w=bin_w,
+        survivors=survivors,
+    )
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=5e-5)
+
+
+def test_multi_block_output_lanes_match_oracle(rng):
+    # n_bins * survivors > 128 forces a multiple-of-128-lane output block:
+    # the lowering rule the round-2 kernel broke, now exercised as a
+    # first-class geometry — both the _geometry arithmetic AND a real
+    # kernel run at out_w = 256
+    from knn_tpu.ops.pallas_knn import _geometry
+
+    assert _geometry(4 * BIN_W, BIN_W, 64) == (4, 8, 128, 128)  # capped
+    assert _geometry(16 * BIN_W, BIN_W, 2) == (16, 2, 128, 128)
+    assert _geometry(32 * BIN_W, BIN_W, 8) == (32, 8, 256, 128)
+    assert _geometry(160 * BIN_W, BIN_W, 1) == (160, 1, 256, 256)
+
+    # out_w = 256 kernel run: 32 bins x 8 survivors per tile
+    db = rng.normal(size=(2 * 32 * BIN_W + 77, 8)).astype(np.float32) * 5
+    queries = rng.normal(size=(5, 8)).astype(np.float32) * 5
+    k = 5
+    ref_d, ref_i = _oracle(db, queries, k)
+    d, i, _ = knn_search_pallas(queries, db, k, tile_n=32 * BIN_W, margin=6,
+                                survivors=8)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=5e-5)
+
+    # bound_w = 256 kernel run: 160 bins per tile
+    d, i, _ = knn_search_pallas(queries, db, k, tile_n=160 * BIN_W, margin=6,
+                                survivors=1)
+    np.testing.assert_array_equal(i, ref_i)
+
+
+def test_final_select_approx_stays_exact(rng):
+    # approx_max_k as the final candidate select: the exclusion value is
+    # restored exactly (masked min over the de-selected), so the result
+    # must STILL match the float64 oracle — misses surface as fallbacks,
+    # never as wrong neighbors
+    db = rng.normal(size=(12 * BIN_W + 9, 24)).astype(np.float32) * 20
+    db[300:340] = db[:40]  # cross-bin ties
+    queries = rng.normal(size=(17, 24)).astype(np.float32) * 20
+    ref_d, ref_i = _oracle(db, queries, 8)
+    d, i, stats = knn_search_pallas(queries, db, 8, tile_n=4 * BIN_W,
+                                    margin=8, final_select="approx")
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=5e-5)
+
+
+def test_bit_mask_roundtrip(rng):
+    import jax
+
+    from knn_tpu.parallel.sharded import _pack_bits_u32, unpack_bits_u32
+
+    for b in (1, 31, 32, 33, 116, 128):
+        mask = rng.random((9, b)) < 0.3
+        packed = jax.jit(_pack_bits_u32)(jnp.asarray(mask))
+        assert packed.shape == (9, -(-b // 32))
+        out = unpack_bits_u32(np.asarray(packed), b)
+        np.testing.assert_array_equal(out, mask)

@@ -164,3 +164,49 @@ def test_return_distances_false_uniform_contract(data, selector):
     )
     assert d is None
     np.testing.assert_array_equal(i, ref_i)
+
+
+def test_adaptive_gap_threshold_kills_false_alarms(rng):
+    # a db row sits WITHIN the count pass's f32 tolerance of d_k: the old
+    # fixed threshold (d_k + tol) counted it and false-alarmed into the
+    # exact fallback; the adaptive form finds the first >2*tol gap at
+    # rank j >= k inside the margin window and counts against its
+    # midpoint instead — certified, zero fallbacks, result still exact
+    from knn_tpu.ops.certified import certification_tolerance
+
+    dim, k = 4, 3
+    base = 3000.0
+    db = rng.normal(size=(512, dim)).astype(np.float32)
+    db = db / np.linalg.norm(db, axis=-1, keepdims=True)
+    radii = np.linspace(base, base * 1.4, 512).astype(np.float32)
+    db = db * radii[:, None]
+    queries = np.zeros((5, dim), dtype=np.float32)
+    tol = certification_tolerance(queries, db)[0]
+    assert tol > 1.0  # the scale makes the f32 slack material
+    # plant ranks 0..k: the (k+1)-th neighbor within tol/4 of the k-th,
+    # then a clean > 2*tol gap before everything else
+    r_k = base
+    tight_rows = np.eye(dim, dtype=np.float32)[:1] * np.sqrt(
+        np.array([r_k**2 - 3, r_k**2 - 2, r_k**2 - 1, r_k**2,
+                  r_k**2 + tol / 4], dtype=np.float64)
+    ).astype(np.float32)[:, None]
+    db[:5] = tight_rows
+    db[5:] = db[5:] * 1.2  # push the rest past a comfortable gap
+    ref_d, ref_i = _oracle(db, queries, k)
+    prog = ShardedKNN(db, mesh=make_mesh(1, 1), k=k)
+    d, i, stats = prog.search_certified(queries, selector="exact", margin=8)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-9)
+    assert stats["fallback_queries"] == 0
+
+
+def test_certified_counted_margin_zero(rng):
+    # m == k: the adaptive gap search has no window — must degrade to the
+    # fixed threshold without indexing past the candidate array
+    db = rng.normal(size=(64, 8)).astype(np.float32)
+    queries = rng.normal(size=(5, 8)).astype(np.float32)
+    ref_d, ref_i = _oracle(db, queries, 4)
+    prog = ShardedKNN(db, mesh=make_mesh(1, 1), k=4)
+    d, i, stats = prog.search_certified(queries, selector="exact", margin=0)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-9)
