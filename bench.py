@@ -61,6 +61,10 @@ def _env_int(name, default):
     return int(os.environ.get(name, default))
 
 
+def _env_opt_int(name):
+    return int(os.environ[name]) if name in os.environ else None
+
+
 #: BASELINE.json configs 3/4/5.  ``certifiable`` = the count-below
 #: certificate applies (squared-L2 bound -> l2 only; cosine reports
 #: measured recall instead).
@@ -88,12 +92,9 @@ try:
     PALLAS_PRECISION = os.environ.get("KNN_BENCH_PALLAS_PRECISION", "bf16x3")
     #: pallas kernel geometry overrides (None = ops.pallas_knn defaults);
     #: the defaults are the measured sweep winners on v5e (TUNING_r03)
-    PALLAS_TILE = (int(os.environ["KNN_BENCH_PALLAS_TILE"])
-                   if "KNN_BENCH_PALLAS_TILE" in os.environ else None)
-    PALLAS_BIN_W = (int(os.environ["KNN_BENCH_PALLAS_BIN_W"])
-                    if "KNN_BENCH_PALLAS_BIN_W" in os.environ else None)
-    PALLAS_SURVIVORS = (int(os.environ["KNN_BENCH_PALLAS_SURVIVORS"])
-                        if "KNN_BENCH_PALLAS_SURVIVORS" in os.environ else None)
+    PALLAS_TILE = _env_opt_int("KNN_BENCH_PALLAS_TILE")
+    PALLAS_BIN_W = _env_opt_int("KNN_BENCH_PALLAS_BIN_W")
+    PALLAS_SURVIVORS = _env_opt_int("KNN_BENCH_PALLAS_SURVIVORS")
     PALLAS_FINAL = os.environ.get("KNN_BENCH_PALLAS_FINAL", "approx")
     #: pallas sweep batch size (0/unset = one full-size batch); smaller
     #: batches pipeline the d2h transfer under later batches' compute

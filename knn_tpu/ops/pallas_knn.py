@@ -419,8 +419,7 @@ def local_certified_candidates(
         # bound via a masked min, so a recall miss here can only cause a
         # fallback, never a wrong certificate.  (~40% cheaper than the
         # full top_k at SIFT candidate widths.)
-        neg, sel = lax.approx_max_k(-cd, m + 1, recall_target=0.999)
-        vals = -neg
+        _, sel = lax.approx_max_k(-cd, m + 1, recall_target=0.999)
         lidx = jnp.take_along_axis(ci, sel, axis=-1)
         masked = cd.at[jnp.arange(n_q)[:, None], sel].set(jnp.inf)
         excl = jnp.min(masked, axis=-1)

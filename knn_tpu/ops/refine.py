@@ -125,7 +125,16 @@ def refine_exact(
         raise ValueError(f"need >= {k} candidates, got {m}")
     valid = cand_idx < db.shape[0]
     safe_idx = np.where(valid, cand_idx, 0)
-    d = _pairwise_f64(queries, db[safe_idx], metric)
+    # chunk the [Qc, m, D] float64 gather+diff temporaries to a ~8 MB
+    # budget so they live in cache: at SIFT bench shape the unchunked
+    # form allocated ~1 GB twice over and ran ~40% slower (measured
+    # chunk sweep, 2026-07)
+    d = np.empty((n_q, m))
+    chunk = max(1, (1 << 20) // max(1, m * db.shape[1]))
+    for lo in range(0, n_q, chunk):
+        d[lo : lo + chunk] = _pairwise_f64(
+            queries[lo : lo + chunk], db[safe_idx[lo : lo + chunk]], metric
+        )
     d = np.where(valid, d, np.inf)
     # kill duplicate candidates (keep lowest occurrence by (d, idx) order)
     srt = np.lexsort((cand_idx, d), axis=-1)

@@ -140,11 +140,13 @@ mesh = make_mesh()
 prog = ShardedKNN(db, mesh=mesh, k=K, metric="l2", train_tile=131072,
                   compute_dtype="bfloat16")
 
+# NOTE: measured 2026-07-30 against the pre-packing program (four
+# separate outputs); the program now returns ONE packed int32 array, so
+# the itemized-fetch probe fetches that single array instead.
 for bq, fs in ((None, "exact"), (64, "exact"), (64, "approx")):
     try:
-        pp, m = prog._pallas_setup(28, None, "bf16x3", block_q=bq,
-                                   final_select=fs)
-        w = min(K + 17, m + 1)
+        pp, m, w = prog._pallas_setup(28, None, "bf16x3", block_q=bq,
+                                      final_select=fs)
         qp, _ = prog._place_queries(queries)
         norm_op = np.float32(prog._db_norm_max())
         out = pp(qp, prog._tp, norm_op)
@@ -163,23 +165,14 @@ for bq, fs in ((None, "exact"), (64, "exact"), (64, "approx")):
             jax.block_until_ready(out)
             ts.append(time.perf_counter() - t0)
         t_dev = min(ts)
-        # (c) fetches, itemized
+        # (c) the one packed fetch
         t0 = time.perf_counter()
-        gi = np.asarray(out[1])
-        t_gi = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        dk = np.asarray(out[0])
-        t_dk = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        bits = np.asarray(out[2])
-        badf = np.asarray(out[3])
-        t_rest = time.perf_counter() - t0
+        packed = np.asarray(out)
+        t_fetch = time.perf_counter() - t0
         emit(probe="phase_budget", block_q=bq, final_select=fs,
              h2d_queries_s=round(t_h2d, 4), device_s=round(t_dev, 4),
-             fetch_gi_s=round(t_gi, 4), fetch_dk_s=round(t_dk, 4),
-             fetch_rest_s=round(t_rest, 4),
-             gi_mb=round(gi.nbytes / 1e6, 2),
-             dk_mb=round(dk.nbytes / 1e6, 2),
+             fetch_packed_s=round(t_fetch, 4),
+             packed_mb=round(packed.nbytes / 1e6, 2),
              device_qps=round(NQ / t_dev, 1))
     except Exception as e:
         emit(probe="phase_budget", block_q=bq, final_select=fs,
