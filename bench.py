@@ -135,6 +135,18 @@ def _emit(obj):
     sys.stdout.flush()
 
 
+def _vlog(msg):
+    """Stage progress on stderr when KNN_BENCH_VERBOSE=1 — the bench's
+    stdout carries exactly one JSON line, so diagnosing a hang (stale
+    device claim, slow remote compile) needs a side channel."""
+    if os.environ.get("KNN_BENCH_VERBOSE") == "1":
+        print(f"[bench +{time.monotonic() - _T0:.0f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+
+_T0 = time.monotonic()
+
+
 def _fail(stage, err, **extra):
     _emit({
         "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
@@ -235,6 +247,7 @@ def _cpu_baseline(db, sub):
 
 
 def main() -> None:
+    _vlog("init backend ...")
     jax = _init_backend()
     dev = jax.devices()[0]
     backend = jax.default_backend()
@@ -254,7 +267,9 @@ def main() -> None:
     queries = (rng.random(size=(NQ, DIM)) * 128.0).astype(np.float32)
     sub = queries[:CPU_QUERIES]
 
+    _vlog(f"data generated ({N}x{DIM}); CPU baseline on {CPU_QUERIES} queries ...")
     cpu_qps, cpu_per_q_s, oracle_idx = _cpu_baseline(db, sub)
+    _vlog(f"cpu baseline done: {cpu_qps and round(cpu_qps, 2)} q/s")
 
     global METRIC
     metric_label = METRIC
@@ -297,12 +312,14 @@ def main() -> None:
         return ShardedKNN(db, mesh=mesh, k=K, metric=METRIC,
                           train_tile=tile, compute_dtype=dtype)
 
+    _vlog("placing database on device ...")
     prog = build(DTYPE)
     if DTYPE == "bfloat16" and oracle_idx is not None:
         # recall-gate the dtype before committing to the full measurement:
         # bf16 matmuls that misrank past the margin can't be repaired on
         # the non-certified path, so demote to float32 (certified modes
         # self-repair either way, but the headline must stay exact)
+        _vlog("bf16 recall gate ...")
         _, ci = prog.search(sub, k=coarse_k)
         _, ri = refine_exact(db, sub, np.asarray(ci), K, METRIC)
         if recall_at_k(ri, oracle_idx) < 1.0:
@@ -425,6 +442,7 @@ def main() -> None:
         entry = {}
         try:
             fn = sweeps[mode]
+            _vlog(f"mode {mode}: recall check + warm ...")
             if oracle_idx is not None:
                 idx_sub, _ = fn(sub)  # also compiles every program involved
                 entry["recall_at_k"] = recall_at_k(idx_sub, oracle_idx)
@@ -435,10 +453,12 @@ def main() -> None:
             fn(queries if mode == "certified_pallas" else queries[:BATCH])
             times = []
             stats = None
+            _vlog(f"mode {mode}: timed runs ...")
             for _ in range(RUNS):
                 t0 = time.perf_counter()
                 _, stats = fn(queries)
                 times.append(time.perf_counter() - t0)
+            _vlog(f"mode {mode}: done ({round(NQ / float(np.mean(times)), 1)} q/s)")
             if trace_dir:
                 # one extra instrumented run, OUTSIDE the timed stats —
                 # profiler overhead must not skew the headline numbers
@@ -522,12 +542,18 @@ def main() -> None:
     # artifact is internally reproducible (round-2 advisor finding)
     cpu_qps_r = round(cpu_qps, 2) if cpu_qps else None
 
+    # the chip's own rate, net of the harness's host<->device relay —
+    # surfaced top-level because on the dev harness the relay, not the
+    # TPU, binds the end-to-end number
+    dev_qps = (results.get("certified_pallas", {})
+               .get("phase_breakdown", {}).get("device_qps"))
     _emit({
         "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
         "value": qps,
         "unit": "queries/s",
         "vs_baseline": round(qps / cpu_qps_r, 2) if cpu_qps_r else None,
         "mode": best,
+        "device_phase_qps": dev_qps,
         "recall_at_k": results[best].get("recall_at_k"),
         **recall_flag,
         "compute_dtype": DTYPE,

@@ -94,9 +94,13 @@ _I32MAX = jnp.iinfo(jnp.int32).max
 #: bf16 high/low parts, three MXU passes reconstruct the f32 product to
 #: ~2^-17 relative accuracy at half the cost of a native f32 HIGHEST
 #: matmul (Mosaic rejects Precision.HIGH, so the split is done by hand).
-#: "highest" is the native f32 path; "default" is for experiments only —
-#: its error is certificate-hostile (~2^-10 relative, measured).
-PRECISIONS = ("bf16x3", "highest", "default")
+#: "bf16x3f" computes the SAME three-term sum as one dot over a 3x-wide
+#: contraction ([qh|qh|ql] @ [th|tl|th]^T) — one MXU op and one f32
+#: accumulator instead of three partials round-tripping VMEM; identical
+#: error model, 1.5x the db streaming bytes.  "highest" is the native
+#: f32 path; "default" is for experiments only — its error is
+#: certificate-hostile (~2^-10 relative, measured).
+PRECISIONS = ("bf16x3", "bf16x3f", "highest", "default")
 
 #: relative slack of the device rank stage's direct-difference f32
 #: distances: per-term (q-t)^2 rounding plus the depth-7 tree reduce give
@@ -156,6 +160,14 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
                                 preferred_element_type=jnp.float32)
               + lax.dot_general(ql, th, dn,
                                 preferred_element_type=jnp.float32))
+    elif precision == "bf16x3f":
+        # fused form of the same sum: ONE dot over a 3x contraction
+        t3_ref, tn_ref, d_ref, i_ref, b_ref, *scratch = refs
+        qh = q.astype(jnp.bfloat16)
+        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        q3 = jnp.concatenate([qh, qh, ql], axis=1)  # [BQ, 3*DIM_CHUNK]
+        qt = lax.dot_general(q3, t3_ref[:], dn,
+                             preferred_element_type=jnp.float32)
     else:
         t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch = refs
         prec = (lax.Precision.HIGHEST if precision == "highest"
@@ -299,13 +311,13 @@ def _bin_candidates(
     grid = (qp // block_q, n_tiles, nd)
     kwargs = {}
     if not interpret:
-        # the [block_q, tile_n] f32 score tile + double-buffered db tile
-        # overflow the default 16 MB scoped-vmem budget at large n_tiles;
-        # v5e has headroom above it, and the explicit limit keeps the
-        # geometry intact
+        # the [block_q, tile_n] f32 score tile + double-buffered db
+        # tiles overflow the default 16 MB scoped-vmem budget; 64 MB
+        # covers every production geometry (the TUNING_r03 variants that
+        # wanted more also measured slower and were dropped)
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
-            vmem_limit_bytes=100 * 1024 * 1024,
+            vmem_limit_bytes=64 * 1024 * 1024,
         )
     if precision == "bf16x3":
         # the high/low split of the db happens ONCE in XLA; the kernel
@@ -316,6 +328,17 @@ def _bin_candidates(
         db_specs = [
             pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
             pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+        ]
+    elif precision == "bf16x3f":
+        # per dim chunk c the fused contraction wants [th_c | tl_c | th_c]
+        th = db.astype(jnp.bfloat16).reshape(db.shape[0], nd, DIM_CHUNK)
+        tl = (db - th.reshape(db.shape).astype(jnp.float32)).astype(
+            jnp.bfloat16).reshape(db.shape[0], nd, DIM_CHUNK)
+        t3 = jnp.concatenate([th, tl, th], axis=2).reshape(
+            db.shape[0], nd * 3 * DIM_CHUNK)
+        db_inputs = [t3]
+        db_specs = [
+            pl.BlockSpec((tile_n, 3 * DIM_CHUNK), lambda qi, ti, di: (ti, di)),
         ]
     else:
         db_inputs = [db]
@@ -510,13 +533,13 @@ def kernel_tolerance(
     base = 4.0 * certification_tolerance(
         queries_np, db_np, db_norm_max=db_norm_max, q_norm=q_norm
     )
-    if precision == "bf16x3":
+    if precision in ("bf16x3", "bf16x3f"):
         return np.maximum(base, 2.0 ** -14 * (q_norm + db_norm_max))
     if precision == "highest":
         return base
     raise ValueError(
         f"precision {precision!r} has no certified tolerance model; "
-        f"use 'bf16x3' or 'highest'"
+        f"use 'bf16x3', 'bf16x3f', or 'highest'"
     )
 
 

@@ -570,19 +570,20 @@ class ShardedKNN:
                       block_q: Optional[int] = None,
                       final_select: str = "exact",
                       include_distances: bool = True):
-        """(program, m) for the one-pass certified path — the ONE home of
-        the kernel-geometry margin cap, shared by :meth:`_certify_pallas`
-        and bench.py's phase breakdown so they can never measure
-        different programs."""
+        """(program, m, analysis_window) for the one-pass certified
+        path — the ONE home of the kernel-geometry margin cap and the
+        packed-output window, shared by :meth:`_certify_pallas` and
+        bench.py's phase breakdown so they can never measure different
+        programs or unpack different column layouts."""
         from knn_tpu.ops.pallas_knn import BIN_W, TILE_N, _geometry
 
-        if precision not in ("bf16x3", "highest"):
+        if precision not in ("bf16x3", "bf16x3f", "highest"):
             # "default" has no certified tolerance model (its matmul error
             # is ~2^-10 relative — certificate-hostile); refuse rather
             # than silently certify garbage
             raise ValueError(
                 f"precision {precision!r} has no certified tolerance "
-                f"model; use 'bf16x3' or 'highest'"
+                f"model; use 'bf16x3', 'bf16x3f', or 'highest'"
             )
 
         eff_bin = bin_w or BIN_W
@@ -867,7 +868,7 @@ def _pallas_certified_program(
         # 32 eps total; bf16x3's 2^-14 dwarfs the f32 terms either way
         q32 = q.astype(jnp.float32)
         q_norm = jnp.sum(q32 * q32, axis=-1)
-        if precision == "bf16x3":
+        if precision in ("bf16x3", "bf16x3f"):
             tol = 2.0 ** -14 * (q_norm + db_norm_max)
         else:
             tol = 32.0 * float(np.finfo(np.float32).eps) * (

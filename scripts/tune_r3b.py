@@ -145,7 +145,7 @@ prog = ShardedKNN(db, mesh=mesh, k=K, metric="l2", train_tile=131072,
 # the itemized-fetch probe fetches that single array instead.
 for bq, fs in ((None, "exact"), (64, "exact"), (64, "approx")):
     try:
-        pp, m, w = prog._pallas_setup(28, None, "bf16x3", block_q=bq,
+        pp, m, _ = prog._pallas_setup(28, None, "bf16x3", block_q=bq,
                                       final_select=fs)
         qp, _ = prog._place_queries(queries)
         norm_op = np.float32(prog._db_norm_max())

@@ -74,7 +74,7 @@ def test_dim_chunking_matches_unchunked_scores(rng):
         assert set(t.tolist()) <= set(c.tolist())
 
 
-@pytest.mark.parametrize("precision", ["highest", "bf16x3"])
+@pytest.mark.parametrize("precision", ["highest", "bf16x3", "bf16x3f"])
 def test_exclusion_bound_is_sound(rng, precision):
     # THE property the one-pass certificate rests on: every db point
     # outside the candidate set must have kernel-space score >= lb
