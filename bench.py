@@ -230,9 +230,31 @@ def recall_at_k(pred_idx: np.ndarray, true_idx: np.ndarray) -> float:
     return hits / true_idx.size
 
 
+#: the baseline is deterministic (fixed-seed data, same binary, same
+#: machine), and at gist shape it costs ~12 min — cache it on disk so a
+#: device-holding bench run doesn't re-burn that time.  The JSON marks
+#: reused measurements with cpu_baseline_cached so the claim stays
+#: auditable; KNN_BENCH_CPU_CACHE=0 forces a fresh measurement.
+_CPU_CACHE_USED = False
+
+
 def _cpu_baseline(db, sub):
     """Native C++ brute force (reference semantics) on the subsample:
     (qps, mean per-query seconds, exact f64 top-K indices)."""
+    global _CPU_CACHE_USED
+    cache = None
+    if os.environ.get("KNN_BENCH_CPU_CACHE", "1") != "0":
+        cache = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f".bench_cpu_{CONFIG}_{METRIC}_n{N}_d{DIM}_k{K}_q{len(sub)}.npz",
+        )
+        if os.path.exists(cache):
+            try:
+                z = np.load(cache)
+                _CPU_CACHE_USED = True
+                return float(z["qps"]), float(z["per_q"]), z["idx"]
+            except Exception:
+                pass
     try:
         from knn_tpu import native
 
@@ -241,7 +263,13 @@ def _cpu_baseline(db, sub):
         t0 = time.perf_counter()
         _, idx = native.knn_search(db, sub, K, METRIC, num_threads=8)
         elapsed = time.perf_counter() - t0
-        return len(sub) / elapsed, elapsed / len(sub), idx
+        qps, per_q = len(sub) / elapsed, elapsed / len(sub)
+        if cache:
+            try:
+                np.savez(cache, qps=qps, per_q=per_q, idx=idx)
+            except Exception:
+                pass
+        return qps, per_q, idx
     except Exception:
         return None, None, None
 
@@ -564,6 +592,7 @@ def main() -> None:
         "peak_flops_assumed": peak,
         "selectors": results,
         "cpu_baseline_qps": cpu_qps_r,
+        "cpu_baseline_cached": _CPU_CACHE_USED,
         "cpu_queries": CPU_QUERIES,
         "cpu_per_query_s": round(cpu_per_q_s, 4) if cpu_per_q_s else None,
         "devices": len(mesh.devices.ravel()),

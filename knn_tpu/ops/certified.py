@@ -14,13 +14,20 @@ This module closes the gap with a certificate:
 2. **refine**: ops.refine re-scores candidates in float64 → provisional
    exact top-k and its kth distance d_k;
 3. **certify**: one more matmul-bound pass counts, per query, the database
-   points with float32 distance < d_k + tol, where tol bounds the float32
-   distance error.  If the count is exactly k, every point at true
-   distance <= d_k is already among the candidates (a missed one would
-   raise the count) — the result is certified exact;
-4. **fallback**: queries failing certification (misses OR tol false
-   alarms) rerun through the exact tiled path.  Soundness never depends on
-   the false-alarm rate; only speed does.
+   points with float32 distance below a threshold, where the float32
+   error bound tol (``certification_tolerance``) sets the slack.  The
+   sharded driver (parallel.sharded._certify_counted) picks the
+   threshold ADAPTIVELY: the refine knows every candidate's float64
+   distance, so it counts against the midpoint of the first
+   inter-neighbor gap at rank j >= k that clears 2*tol — count <= j
+   proves no outsider sits at or below the j-th candidate, and ranks
+   <= j are float64-refined.  (A fixed ``d_k + tol`` threshold
+   false-alarms whenever ANY point lies within tol of d_k — measured
+   ~2.4% of SIFT1M queries; a clearable gap inside the margin window
+   almost always exists, so the adaptive form certifies those.)
+4. **fallback**: queries failing certification (misses OR gapless tie
+   windows) rerun through the exact tiled path.  Soundness never depends
+   on the false-alarm rate; only speed does.
 
 Net effect: exact results (recall@k = 1.0 by construction) at the
 approximate path's throughput, with a fallback whose cost scales with the

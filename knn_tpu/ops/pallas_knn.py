@@ -319,27 +319,28 @@ def _bin_candidates(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024,
         )
-    if precision == "bf16x3":
+    if precision in ("bf16x3", "bf16x3f"):
         # the high/low split of the db happens ONCE in XLA; the kernel
         # streams bf16 tiles and never re-derives them per query block
         th = db.astype(jnp.bfloat16)
         tl = (db - th.astype(jnp.float32)).astype(jnp.bfloat16)
-        db_inputs = [th, tl]
-        db_specs = [
-            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
-            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
-        ]
-    elif precision == "bf16x3f":
-        # per dim chunk c the fused contraction wants [th_c | tl_c | th_c]
-        th = db.astype(jnp.bfloat16).reshape(db.shape[0], nd, DIM_CHUNK)
-        tl = (db - th.reshape(db.shape).astype(jnp.float32)).astype(
-            jnp.bfloat16).reshape(db.shape[0], nd, DIM_CHUNK)
-        t3 = jnp.concatenate([th, tl, th], axis=2).reshape(
-            db.shape[0], nd * 3 * DIM_CHUNK)
-        db_inputs = [t3]
-        db_specs = [
-            pl.BlockSpec((tile_n, 3 * DIM_CHUNK), lambda qi, ti, di: (ti, di)),
-        ]
+        if precision == "bf16x3":
+            db_inputs = [th, tl]
+            db_specs = [
+                pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+                pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+            ]
+        else:
+            # per dim chunk c the fused contraction reads [th_c|tl_c|th_c]
+            th3 = th.reshape(db.shape[0], nd, DIM_CHUNK)
+            tl3 = tl.reshape(db.shape[0], nd, DIM_CHUNK)
+            t3 = jnp.concatenate([th3, tl3, th3], axis=2).reshape(
+                db.shape[0], nd * 3 * DIM_CHUNK)
+            db_inputs = [t3]
+            db_specs = [
+                pl.BlockSpec((tile_n, 3 * DIM_CHUNK),
+                             lambda qi, ti, di: (ti, di)),
+            ]
     else:
         db_inputs = [db]
         db_specs = [

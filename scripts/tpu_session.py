@@ -89,6 +89,39 @@ def pallas_proof():
     d, i, stats = knn_search_pallas(q[:32], db, k)
     cert_ok = bool((i == oi).all())
     log(f"pallas certified pipeline exact vs oracle: {cert_ok}, stats={stats}")
+    forensics = None
+    if not cert_ok:
+        # soundness forensics: which rows differ, were they flagged bad,
+        # and what is the float64 margin of the certificate inequality
+        # for the missing neighbors?  (A genuine miss that was NOT
+        # flagged is a soundness failure — TUNING/BENCH must not ship on
+        # top of one silently.)
+        from knn_tpu.ops.pallas_knn import local_certified_candidates
+
+        bad_rows = [int(r) for r in np.nonzero((i != oi).any(axis=1))[0]]
+        d32, lidx, lb = local_certified_candidates(
+            jnp.asarray(q[:32]), jnp.asarray(db), m=128, interpret=False)
+        d32, lidx, lb = map(np.asarray, (d32, lidx, lb))
+        q64, db64 = q[:32].astype(np.float64), db.astype(np.float64)
+        forensics = []
+        for r in bad_rows:
+            missing = sorted(set(oi[r].tolist()) - set(i[r].tolist()))
+            in_cands = [bool(mi in set(lidx[r].tolist())) for mi in missing]
+            s_true = (db64[missing] ** 2).sum(-1) - 2.0 * (
+                db64[missing] @ q64[r])
+            qn = float((q64[r] ** 2).sum())
+            dk = float(np.sort(((db64 - q64[r]) ** 2).sum(-1))[k - 1])
+            tol = float(2.0 ** -14 * (qn + (db64 ** 2).sum(-1).max()))
+            forensics.append({
+                "row": r,
+                "missing_idx": missing,
+                "missing_in_candidates": in_cands,
+                "s_true_missing": [float(x) for x in s_true],
+                "lb": float(lb[r]),
+                "s_k_true": dk - qn,
+                "cert_margin_f64": float(lb[r] - (dk - qn) - tol),
+            })
+            log(f"  forensic row {r}: {forensics[-1]}")
 
     # microbenchmark: selector-only device time at fixed shapes
     timings = {}
@@ -118,10 +151,16 @@ def pallas_proof():
     rec = {"pallas_proof": {"recall_refined": pal_recall,
                             "certified_exact": cert_ok,
                             "selector_seconds_per_256q": timings,
-                            "stats": stats}}
+                            "stats": stats,
+                            **({"forensics": forensics} if forensics else {})}}
     with open(OUT, "a") as f:
         f.write(json.dumps(rec) + "\n")
     return rec
+
+
+#: set by pallas_proof; stamped into every bench line so a bench result
+#: can never be read apart from its compiled-soundness gate
+GATE_OK = None
 
 
 def run_bench(config):
@@ -145,18 +184,27 @@ def run_bench(config):
     except SystemExit as e:
         log(f"bench[{config}] exited rc={e.code}")
     line = buf.getvalue().strip().splitlines()[-1] if buf.getvalue().strip() else ""
-    print(line, flush=True)
     if line:
+        try:  # stamp the compiled-soundness gate outcome into the record
+            rec = json.loads(line)
+            rec["pallas_gate_ok"] = GATE_OK
+            line = json.dumps(rec)
+        except Exception:
+            pass
+        print(line, flush=True)
         with open(OUT, "a") as f:
             f.write(line + "\n")
 
 
 def main():
+    global GATE_OK
     try:
-        pallas_proof()
+        rec = pallas_proof()
+        GATE_OK = bool(rec["pallas_proof"]["certified_exact"])
     except Exception as e:  # keep going: bench evidence > pallas evidence
         import traceback
 
+        GATE_OK = False
         log(f"pallas proof FAILED: {e!r}")
         traceback.print_exc()
         with open(OUT, "a") as f:
