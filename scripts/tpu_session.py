@@ -196,6 +196,40 @@ def run_bench(config):
             f.write(line + "\n")
 
 
+def kernel_ab():
+    """bf16x3 (three dots) vs bf16x3f (one fused 3x-contraction dot)
+    kernel-only A/B at the SIFT bench shape — decides the production
+    default.  TPU_SESSION_AB=1 enables."""
+    import jax.numpy as jnp
+
+    from knn_tpu.ops.pallas_knn import _bin_candidates
+
+    rng = np.random.default_rng(0)
+    db = jnp.asarray((rng.random((1_000_000, 128)) * 128).astype(np.float32))
+    qs = jnp.asarray((rng.random((4096, 128)) * 128).astype(np.float32))
+    out = {}
+    for prec in ("bf16x3", "bf16x3f"):
+        try:
+            o = _bin_candidates(qs, db, block_q=128, tile_n=8192, bin_w=128,
+                                survivors=2, precision=prec, interpret=False)
+            jax.block_until_ready(o)
+            ts = []
+            for _ in range(3):
+                t0 = time.time()
+                o = _bin_candidates(qs, db, block_q=128, tile_n=8192,
+                                    bin_w=128, survivors=2, precision=prec,
+                                    interpret=False)
+                jax.block_until_ready(o)
+                ts.append(time.time() - t0)
+            out[prec] = round(min(ts) * 1e3, 1)
+            log(f"  kernel A/B {prec}: {out[prec]} ms / 4096 queries")
+        except Exception as e:
+            out[prec] = f"error: {str(e)[:120]}"
+            log(f"  kernel A/B {prec} FAILED: {str(e)[:120]}")
+    with open(OUT, "a") as f:
+        f.write(json.dumps({"kernel_ab_ms_per_4096": out}) + "\n")
+
+
 def main():
     global GATE_OK
     try:
@@ -209,6 +243,12 @@ def main():
         traceback.print_exc()
         with open(OUT, "a") as f:
             f.write(json.dumps({"pallas_proof": {"error": repr(e)}}) + "\n")
+
+    if os.environ.get("TPU_SESSION_AB") == "1":
+        try:
+            kernel_ab()
+        except Exception as e:
+            log(f"kernel A/B FAILED: {e!r}")
 
     configs = os.environ.get("TPU_SESSION_CONFIGS", "sift1m").split(",")
     for c in configs:
