@@ -200,26 +200,31 @@ def kernel_ab():
     """bf16x3 (three dots) vs bf16x3f (one fused 3x-contraction dot)
     kernel-only A/B at the SIFT bench shape — decides the production
     default.  TPU_SESSION_AB=1 enables."""
-    import jax.numpy as jnp
-
     from knn_tpu.ops.pallas_knn import _bin_candidates
 
     rng = np.random.default_rng(0)
-    db = jnp.asarray((rng.random((1_000_000, 128)) * 128).astype(np.float32))
-    qs = jnp.asarray((rng.random((4096, 128)) * 128).astype(np.float32))
+    db = jnp.asarray(rng.random((1_000_000, 128), dtype=np.float32) * 128)
+    qs = jnp.asarray(rng.random((4096, 128), dtype=np.float32) * 128)
+
+    def fence(o):
+        # block_until_ready does NOT block through the axon relay
+        # (pallas_proof.timeit, measured round 3): a tiny host fetch is
+        # the only real fence
+        np.asarray(o[2][:1, :1]).ravel()
+
     out = {}
     for prec in ("bf16x3", "bf16x3f"):
         try:
             o = _bin_candidates(qs, db, block_q=128, tile_n=8192, bin_w=128,
                                 survivors=2, precision=prec, interpret=False)
-            jax.block_until_ready(o)
+            fence(o)
             ts = []
             for _ in range(3):
                 t0 = time.time()
                 o = _bin_candidates(qs, db, block_q=128, tile_n=8192,
                                     bin_w=128, survivors=2, precision=prec,
                                     interpret=False)
-                jax.block_until_ready(o)
+                fence(o)
                 ts.append(time.time() - t0)
             out[prec] = round(min(ts) * 1e3, 1)
             log(f"  kernel A/B {prec}: {out[prec]} ms / 4096 queries")
