@@ -17,19 +17,31 @@ def rank(rec):
 
 best = {}
 order = []
-for line in open(SRC):
-    try:
-        rec = json.loads(line)
-    except json.JSONDecodeError:
-        continue
-    cfg = rec.get("metric")
-    if not cfg or rec.get("value") is None:
-        continue
-    if cfg not in best:
-        order.append(cfg)
-    # prefer greener gates; among equals, later (fresher) wins
-    if cfg not in best or rank(rec) >= rank(best[cfg]):
-        best[cfg] = rec
+
+
+def feed(path):
+    if not os.path.exists(path):
+        return
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        cfg = rec.get("metric")
+        if not cfg or rec.get("value") is None:
+            continue
+        if cfg not in best:
+            order.append(cfg)
+        # prefer greener gates; among equals, later (fresher) wins
+        if cfg not in best or rank(rec) >= rank(best[cfg]):
+            best[cfg] = rec
+
+
+# seed with the currently-curated lines (configs whose session lines
+# predate tpu_bench_lines.jsonl's rotation must survive a refresh),
+# then let fresher session lines supersede them
+feed(DST)
+feed(SRC)
 
 with open(DST, "w") as f:
     for cfg in order:
