@@ -120,18 +120,44 @@ def _round_up(x: int, multiple: int) -> int:
     return -(-x // multiple) * multiple
 
 
+#: select-phase layouts.  "grouped": bins are indexed by LANE (128 bins
+#: per tile, members strided 128 apart); the per-bin reduction runs over
+#: the vreg-group axis as ELEMENTWISE vector min/compare/select chains —
+#: no cross-lane shuffles at all.  "lane": the round-3 layout (bins are
+#: contiguous 128-lane spans; min/argmin reduce over lanes, ~7 shuffle
+#: rounds per reduction) — kept for A/B and as a fallback.  The select
+#: phase was the kernel's bottleneck (device MFU 2.25%, VERDICT r3
+#: item 2): the same math as a lane reduction costs ~5x fewer VPU ops
+#: when the reduced axis is the sublane-group axis.
+BINNINGS = ("grouped", "lane")
+
+
 def _geometry(
-    tile_n: int, bin_w: int = BIN_W, survivors: Optional[int] = None
+    tile_n: int, bin_w: int = BIN_W, survivors: Optional[int] = None,
+    binning: str = "grouped",
 ) -> Tuple[int, int, int, int]:
     """(n_bins, survivors, out_w, bound_w) for a db tile.  Output blocks
     are lane-aligned: ``out_w = round_up(n_bins * survivors, 128)`` lanes
     of candidates per cell (padded with +inf/sentinel), ``bound_w`` lanes
     of per-bin exclusion bounds.  ``survivors=None`` picks the largest
-    count that fits one 128-lane block (the legacy geometry)."""
+    count that fits one 128-lane block in "lane" mode, and 2 (the
+    collision-rate sweet spot, module docstring) in "grouped" mode.
+
+    In "grouped" mode bins are the 128 lanes; ``bin_w`` does not shape
+    the binning (each bin has ``tile_n // 128`` members, strided 128
+    apart), but the tile must still be a multiple of 128."""
+    if binning not in BINNINGS:
+        raise ValueError(f"binning {binning!r} not in {BINNINGS}")
     if tile_n % bin_w:
         raise ValueError(f"tile_n={tile_n} must be a multiple of bin_w={bin_w}")
     if bin_w % BIN_W:
         raise ValueError(f"bin_w={bin_w} must be a multiple of {BIN_W} lanes")
+    if binning == "grouped":
+        n_bins = BIN_W  # one bin per lane
+        if survivors is None:
+            survivors = 2
+        survivors = min(survivors, MAX_SURVIVORS)
+        return n_bins, survivors, survivors * BIN_W, BIN_W
     n_bins = tile_n // bin_w
     if survivors is None:
         survivors = max(1, min(128 // n_bins, MAX_SURVIVORS, bin_w))
@@ -144,7 +170,7 @@ def _geometry(
 
 def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
             survivors: int, out_w: int, bound_w: int, nd: int,
-            precision: str):
+            precision: str, binning: str):
     ti = pl.program_id(1)
     di = pl.program_id(2)
     q = q_ref[:]
@@ -182,13 +208,14 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
     # db row norms arrive precomputed ([8, T] broadcast, row 0 used): an
     # XLA f32 reduction once per call instead of a per-cell ones-matmul
     # (which cost ~12% of the qt matmul as a 6-pass f32 HIGHEST dot)
+    emit = _emit_select_grouped if binning == "grouped" else _emit_select
     if nd == 1:
         # single dim chunk: no scratch allocated, skip the VMEM
         # accumulation round-trip entirely (measured ~16% of kernel time
         # at SIFT shape)
-        _emit_select(ti, qt, tn_ref[:], d_ref, i_ref, b_ref,
-                     tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
-                     survivors=survivors, out_w=out_w, bound_w=bound_w)
+        emit(ti, qt, tn_ref[:], d_ref, i_ref, b_ref,
+             tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
+             survivors=survivors, out_w=out_w, bound_w=bound_w)
         return
     qt_ref, = scratch
 
@@ -202,9 +229,9 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
 
     @pl.when(di == nd - 1)
     def _select():
-        _emit_select(ti, qt_ref[:], tn_ref[:], d_ref, i_ref, b_ref,
-                     tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
-                     survivors=survivors, out_w=out_w, bound_w=bound_w)
+        emit(ti, qt_ref[:], tn_ref[:], d_ref, i_ref, b_ref,
+             tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
+             survivors=survivors, out_w=out_w, bound_w=bound_w)
 
 
 def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
@@ -252,6 +279,54 @@ def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
     b_ref[:] = bound
 
 
+def _emit_select_grouped(ti, qt, tn, d_ref, i_ref, b_ref, *,
+                         tile_n: int, bin_w: int, n_bins: int,
+                         survivors: int, out_w: int, bound_w: int):
+    """Lane-binned survivor/bound emission: bin b = lane b of every
+    128-wide column group, so the per-bin reduction runs over the GROUP
+    axis — a chain of elementwise vector min/compare/select over
+    [BQ, 128] vregs, zero cross-lane shuffles.  One fused pass maintains
+    the running (survivors+1) smallest values per lane (a sorted
+    insertion network) plus the group index of each survivor; the
+    (survivors+1)-th value is the bin's exclusion bound.
+
+    Same soundness contract as ``_emit_select``: every tile row not
+    emitted as a candidate scores >= its bin's bound (rows other than a
+    bin's ``survivors`` smallest score >= the (survivors+1)-th
+    smallest).  ``bin_w`` is unused (bins are lanes); kept for signature
+    parity with the lane-mode emitter."""
+    del bin_w, n_bins  # grouped mode: 128 bins of tile_n // 128 members
+    s = tn[0:1, :] - 2.0 * qt  # [BQ, T], ||q||^2 dropped
+    bq = s.shape[0]
+    n_groups = tile_n // BIN_W
+    lane = lax.broadcasted_iota(jnp.int32, (bq, BIN_W), 1)
+    inf = jnp.full((bq, BIN_W), jnp.inf, jnp.float32)
+    zero = jnp.zeros((bq, BIN_W), jnp.int32)
+    vals = [inf] * (survivors + 1)  # running sorted smallest per lane
+    gidx = [zero] * survivors       # group index of each survivor
+    for g in range(n_groups):
+        cur_v = s[:, g * BIN_W : (g + 1) * BIN_W]
+        cur_g = jnp.full((bq, BIN_W), g, jnp.int32)
+        for j in range(survivors):
+            less = cur_v < vals[j]
+            disp_v = jnp.maximum(cur_v, vals[j])
+            disp_g = jnp.where(less, gidx[j], cur_g)
+            vals[j] = jnp.minimum(cur_v, vals[j])
+            gidx[j] = jnp.where(less, cur_g, gidx[j])
+            cur_v, cur_g = disp_v, disp_g
+        vals[survivors] = jnp.minimum(vals[survivors], cur_v)
+    ds, is_ = [], []
+    for j in range(survivors):
+        ds.append(vals[j])
+        is_.append(jnp.where(jnp.isfinite(vals[j]),
+                             ti * tile_n + gidx[j] * BIN_W + lane, _I32MAX))
+    cd = jnp.concatenate(ds, axis=-1)   # [BQ, survivors * 128] = out_w
+    ci = jnp.concatenate(is_, axis=-1)
+    d_ref[:] = cd
+    i_ref[:] = ci
+    b_ref[:] = vals[survivors]          # [BQ, 128] = bound_w
+
+
 def _pad_axis(x, multiple: int, axis: int, fill: float = 0.0):
     """parallel.mesh.pad_to_multiple without the size return (imported
     lazily: ops must not import the parallel package at module scope)."""
@@ -266,7 +341,7 @@ def _on_tpu() -> bool:
 
 @functools.partial(
     jax.jit, static_argnames=("block_q", "tile_n", "bin_w", "survivors",
-                              "precision", "interpret")
+                              "precision", "interpret", "binning")
 )
 def _bin_candidates(
     queries: jax.Array,
@@ -278,6 +353,7 @@ def _bin_candidates(
     survivors: Optional[int],
     precision: str,
     interpret: bool,
+    binning: str = "grouped",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Kernel launch on padded shapes.  Returns
 
@@ -297,7 +373,8 @@ def _bin_candidates(
     qp, dim = queries.shape
     n_tiles = db.shape[0] // tile_n
     nd = dim // DIM_CHUNK
-    n_bins, survivors, out_w, bound_w = _geometry(tile_n, bin_w, survivors)
+    n_bins, survivors, out_w, bound_w = _geometry(
+        tile_n, bin_w, survivors, binning)
     # full-dim db row norms, f32, broadcast to 8 sublanes so the kernel
     # reads them as a lane-major [8, tile_n] block
     tnorm = jnp.broadcast_to(
@@ -309,7 +386,7 @@ def _bin_candidates(
     kernel = functools.partial(
         _kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
         survivors=survivors, out_w=out_w, bound_w=bound_w, nd=nd,
-        precision=precision,
+        precision=precision, binning=binning,
     )
     grid = (qp // block_q, n_tiles, nd)
     kwargs = {}
@@ -381,7 +458,8 @@ def _bin_candidates(
 @functools.partial(
     jax.jit,
     static_argnames=("m", "tile_n", "block_q", "bin_w", "survivors",
-                     "precision", "final_select", "interpret"),
+                     "precision", "final_select", "interpret", "binning",
+                     "final_recall_target"),
 )
 def local_certified_candidates(
     q: jax.Array,
@@ -395,6 +473,8 @@ def local_certified_candidates(
     precision: str = "bf16x3",
     final_select: str = "exact",
     interpret: Optional[bool] = None,
+    binning: str = "grouped",
+    final_recall_target: Optional[float] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole device-side certified coarse pass against one db (shard):
 
@@ -426,7 +506,7 @@ def local_certified_candidates(
     cd, ci, bounds = _bin_candidates(
         q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
         bin_w=bin_w, survivors=survivors, precision=precision,
-        interpret=interpret,
+        interpret=interpret, binning=binning,
     )
     n_q = q.shape[0]
     cd, ci, bounds = cd[:n_q], ci[:n_q], bounds[:n_q]
@@ -445,8 +525,11 @@ def local_certified_candidates(
         # value restored EXACTLY: every de-selected candidate joins the
         # bound via a masked min, so a recall miss here can only cause a
         # fallback, never a wrong certificate.  (~40% cheaper than the
-        # full top_k at SIFT candidate widths.)
-        _, sel = lax.approx_max_k(-cd, m + 1, recall_target=0.999)
+        # full top_k at SIFT candidate widths.)  ``final_recall_target``
+        # tunes the fallback rate of this one-pass path the same way
+        # ``recall_target`` tunes the counted selector (ADVICE r3).
+        _, sel = lax.approx_max_k(
+            -cd, m + 1, recall_target=final_recall_target or 0.999)
         lidx = jnp.take_along_axis(ci, sel, axis=-1)
         masked = cd.at[jnp.arange(n_q)[:, None], sel].set(jnp.inf)
         excl = jnp.min(masked, axis=-1)
@@ -558,6 +641,8 @@ def knn_search_pallas(
     bin_w: Optional[int] = None,
     survivors: Optional[int] = None,
     final_select: str = "exact",
+    binning: str = "grouped",
+    final_recall_target: Optional[float] = None,
 ) -> Tuple[np.ndarray, np.ndarray, dict]:
     """Certified-exact KNN in ONE database pass on a single-device mesh:
     fused kernel coarse select -> device rank -> exclusion-bound
@@ -583,6 +668,7 @@ def knn_search_pallas(
         np.asarray(queries, dtype=np.float32), margin=margin,
         selector="pallas", tile_n=tile_n, precision=precision,
         bin_w=bin_w, survivors=survivors, final_select=final_select,
+        binning=binning, final_recall_target=final_recall_target,
     )
 
 

@@ -352,6 +352,8 @@ class ShardedKNN:
         bin_w: Optional[int] = None, survivors: Optional[int] = None,
         block_q: Optional[int] = None, final_select: str = "exact",
         recall_target: Optional[float] = None,
+        binning: str = "grouped",
+        final_recall_target: Optional[float] = None,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
         Returns (dists_f64, idx, stats).  L2 only (the certificate is a
@@ -436,7 +438,8 @@ class ShardedKNN:
                 tile_n=tile_n, precision=precision,
                 want_distances=return_distances,
                 bin_w=bin_w, survivors=survivors, block_q=block_q,
-                final_select=final_select,
+                final_select=final_select, binning=binning,
+                final_recall_target=final_recall_target,
             )
         else:
             bad = self._certify_counted(
@@ -569,7 +572,9 @@ class ShardedKNN:
                       survivors: Optional[int] = None,
                       block_q: Optional[int] = None,
                       final_select: str = "exact",
-                      include_distances: bool = True):
+                      include_distances: bool = True,
+                      binning: str = "grouped",
+                      final_recall_target: Optional[float] = None):
         """(program, m, analysis_window) for the one-pass certified
         path — the ONE home of the kernel-geometry margin cap and the
         packed-output window, shared by :meth:`_certify_pallas` and
@@ -590,7 +595,7 @@ class ShardedKNN:
         shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
         eff_tile = min(tile_n or TILE_N,
                        max(eff_bin, -(-shard_rows // eff_bin) * eff_bin))
-        _, _, out_w, _ = _geometry(eff_tile, eff_bin, survivors)
+        _, _, out_w, _ = _geometry(eff_tile, eff_bin, survivors, binning)
         # m is bounded by the db, the per-shard rows, and the kernel's
         # per-shard candidate width minus the two slots the exclusion
         # value needs (ops.pallas_knn.local_certified_candidates)
@@ -606,14 +611,16 @@ class ShardedKNN:
             self.mesh, m, self.k, self.merge, tile_n, precision,
             n_train=self.n_train, bin_w=bin_w, survivors=survivors,
             block_q=block_q, final_select=final_select,
-            include_distances=include_distances,
+            include_distances=include_distances, binning=binning,
+            final_recall_target=final_recall_target,
         )
         return prog, m, _analysis_window(self.k, m)
 
     def _certify_pallas(
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, *,
         tile_n, precision, want_distances=True, bin_w=None, survivors=None,
-        block_q=None, final_select="exact",
+        block_q=None, final_select="exact", binning="grouped",
+        final_recall_target=None,
     ):
         """One-pass certificate, host side.  The device already ranked the
         candidates, flagged uncertified rows, and marked near-tie pairs
@@ -630,7 +637,9 @@ class ShardedKNN:
                                         bin_w=bin_w, survivors=survivors,
                                         block_q=block_q,
                                         final_select=final_select,
-                                        include_distances=want_distances)
+                                        include_distances=want_distances,
+                                        binning=binning,
+                                        final_recall_target=final_recall_target)
 
         # stage 1: dispatch every batch (async on device)
         norm_op = np.float32(db_norm_max)
@@ -779,7 +788,8 @@ def _pallas_certified_program(
     precision: str, n_train: Optional[int] = None,
     bin_w: Optional[int] = None, survivors: Optional[int] = None,
     block_q: Optional[int] = None, final_select: str = "exact",
-    include_distances: bool = True,
+    include_distances: bool = True, binning: str = "grouped",
+    final_recall_target: Optional[float] = None,
 ):
     """ONE-pass sharded self-certifying coarse select + device rank +
     device certificate (ops.pallas_knn.local_certified_candidates per
@@ -828,6 +838,7 @@ def _pallas_certified_program(
         d32, li, lb = local_certified_candidates(
             q, t, m, tile_n=eff_tile, bin_w=eff_bin, survivors=survivors,
             block_q=eff_bq, final_select=final_select, precision=precision,
+            binning=binning, final_recall_target=final_recall_target,
         )
         db_idx = lax.axis_index(DB_AXIS)
         gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,

@@ -11,8 +11,13 @@ DST = os.path.join(REPO, "TPU_BENCH_r03.jsonl")
 
 
 def rank(rec):
-    gate = rec.get("pallas_gate_ok")
-    return {True: 2, None: 1}.get(gate, 0)
+    # explicit true > gate-absent/unknown > explicit false.  A line with
+    # NO gate key ranks BELOW any line carrying an explicit verdict or a
+    # gate_note: a same-session line minus the annotation must never
+    # silently erase a recorded soundness-failure stamp (ADVICE r3).
+    if "pallas_gate_ok" not in rec:
+        return -1 if "gate_note" not in rec else 0
+    return {True: 2, None: 1}.get(rec["pallas_gate_ok"], 0)
 
 
 best = {}
@@ -32,8 +37,20 @@ def feed(path):
             continue
         if cfg not in best:
             order.append(cfg)
-        # prefer greener gates; among equals, later (fresher) wins
-        if cfg not in best or rank(rec) >= rank(best[cfg]):
+            best[cfg] = rec
+            continue
+        cur = best[cfg]
+        # replace on a strictly greener gate; among equals, fresher wins
+        # unless it would DROP an annotation the incumbent carries (a
+        # same-value line minus its gate verdict/failure stamp must not
+        # silently erase it); carry gate_note forward either way
+        incumbent_annotated = "pallas_gate_ok" in cur or "gate_note" in cur
+        take = (rank(rec) > rank(cur)
+                or (rank(rec) == rank(cur)
+                    and ("pallas_gate_ok" in rec or not incumbent_annotated)))
+        if take:
+            if "gate_note" in cur and "gate_note" not in rec:
+                rec = dict(rec, gate_note=cur["gate_note"])
             best[cfg] = rec
 
 

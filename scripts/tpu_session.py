@@ -185,9 +185,15 @@ def run_bench(config):
         log(f"bench[{config}] exited rc={e.code}")
     line = buf.getvalue().strip().splitlines()[-1] if buf.getvalue().strip() else ""
     if line:
-        try:  # stamp the compiled-soundness gate outcome into the record
+        try:  # stamp the session-level gate WITHOUT clobbering bench's own
+            # embedded gate verdict (which tests the exact swept
+            # configuration — ADVICE r3); the session gate runs the
+            # default-config kernel at 200k rows and goes under its own key
+            # pallas_gate_ok stays bench's own (per-config) verdict; a
+            # missing key must stay missing so the artifact refresher can
+            # rank it honestly
             rec = json.loads(line)
-            rec["pallas_gate_ok"] = GATE_OK
+            rec["session_gate_ok"] = GATE_OK
             line = json.dumps(rec)
         except Exception:
             pass

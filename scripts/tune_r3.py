@@ -82,7 +82,7 @@ def time_kernel(bq, tn, bw, sv, prec, nb=8):
     def launch(i):
         return _bin_candidates(
             qj[i * 512:(i + 1) * 512], dbj, block_q=bq, tile_n=tn,
-            bin_w=bw, survivors=sv, precision=prec, interpret=False,
+            bin_w=bw, survivors=sv, precision=prec, interpret=False, binning="lane",
         )
     out = launch(0)
     jax.block_until_ready(out)
@@ -118,7 +118,7 @@ def time_local(bq, tn, bw, sv, fs, nb=8):
     def launch(i):
         return local_certified_candidates(
             qj[i * 512:(i + 1) * 512], dbj, m=M, block_q=bq, tile_n=tn,
-            bin_w=bw, survivors=sv, final_select=fs, interpret=False,
+            bin_w=bw, survivors=sv, final_select=fs, interpret=False, binning="lane",
         )
     out = launch(0)
     jax.block_until_ready(out)

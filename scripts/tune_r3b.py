@@ -66,7 +66,7 @@ for bq in (32, 64, 128):
     def launch(i, bq=bq):
         return _bin_candidates(
             qj[i * 512:(i + 1) * 512], dbj, block_q=bq, tile_n=8192,
-            bin_w=128, survivors=2, precision="bf16x3", interpret=False,
+            bin_w=128, survivors=2, precision="bf16x3", interpret=False, binning="lane",
         )
     try:
         out = launch(0)
@@ -83,14 +83,14 @@ for bq in (32, 64, 128):
 # one full-size launch (the production batch shape): grid amortization
 for bq in (64, 128):
     try:
-        out = _bin_candidates(qj, dbj, block_q=bq, tile_n=8192, bin_w=128,
+        out = _bin_candidates(qj, dbj, binning="lane", block_q=bq, tile_n=8192, bin_w=128,
                               survivors=2, precision="bf16x3",
                               interpret=False)
         jax.block_until_ready(out)
         ts = []
         for _ in range(3):
             t0 = time.perf_counter()
-            out = _bin_candidates(qj, dbj, block_q=bq, tile_n=8192,
+            out = _bin_candidates(qj, dbj, binning="lane", block_q=bq, tile_n=8192,
                                   bin_w=128, survivors=2,
                                   precision="bf16x3", interpret=False)
             jax.block_until_ready(out)
@@ -106,7 +106,7 @@ for bq, fs in ((64, "exact"), (64, "approx"), (128, "approx")):
     def launch(i, bq=bq, fs=fs):
         return local_certified_candidates(
             qj[i * 512:(i + 1) * 512], dbj, m=M, block_q=bq, tile_n=8192,
-            bin_w=128, survivors=2, final_select=fs, interpret=False,
+            bin_w=128, survivors=2, final_select=fs, interpret=False, binning="lane",
         )
     try:
         out = launch(0)
@@ -145,7 +145,7 @@ prog = ShardedKNN(db, mesh=mesh, k=K, metric="l2", train_tile=131072,
 # the itemized-fetch probe fetches that single array instead.
 for bq, fs in ((None, "exact"), (64, "exact"), (64, "approx")):
     try:
-        pp, m, _ = prog._pallas_setup(28, None, "bf16x3", block_q=bq,
+        pp, m, _ = prog._pallas_setup(28, None, "bf16x3", binning="lane", block_q=bq,
                                       final_select=fs)
         qp, _ = prog._place_queries(queries)
         norm_op = np.float32(prog._db_norm_max())

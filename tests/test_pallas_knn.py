@@ -132,18 +132,29 @@ def test_pallas_certified_matches_oracle(rng):
             + stats["fallback_false_alarms"]) == stats["fallback_queries"]
 
 
-def test_pallas_certified_survives_adversarial_bins(rng):
-    # cram the ENTIRE true top-k into one bin with k > MAX_SURVIVORS: the
-    # kernel keeps only the bin's top 8, the bound certificate must flag
-    # the loss and the fallback must still return the exact answer
+@pytest.mark.parametrize("binning", ["lane", "grouped"])
+def test_pallas_certified_survives_adversarial_bins(rng, binning):
+    # cram the ENTIRE true top-k into ONE kernel bin with k >
+    # MAX_SURVIVORS: the kernel keeps only the bin's top 8, the bound
+    # certificate must flag the loss and the fallback must still return
+    # the exact answer.  A bin is a contiguous 128-lane span in "lane"
+    # mode, but one LANE across a tile's column groups in "grouped" mode
+    # — each layout gets its own adversarial packing
     dim, k = 12, 10
-    db = rng.normal(size=(4 * BIN_W, dim)).astype(np.float32) * 50
+    if binning == "lane":
+        tile_n = 2 * BIN_W
+        db = rng.normal(size=(4 * BIN_W, dim)).astype(np.float32) * 50
+        hot = [2 * BIN_W + 3 * j for j in range(k)]  # one 128-lane bin
+    else:
+        tile_n = 12 * BIN_W  # 12 groups of 128 lanes per tile
+        db = rng.normal(size=(tile_n, dim)).astype(np.float32) * 50
+        hot = [7 + BIN_W * g for g in range(k)]  # lane 7 of groups 0..9
     query = rng.normal(size=(1, dim)).astype(np.float32)
-    bin_lo = 2 * BIN_W
-    for j in range(k):
-        db[bin_lo + 3 * j] = query[0] + (j + 1) * 1e-3
+    for j, r in enumerate(hot):
+        db[r] = query[0] + (j + 1) * 1e-3
     ref_d, ref_i = _oracle(db, query, k)
-    d, i, stats = knn_search_pallas(query, db, k, tile_n=2 * BIN_W, margin=4)
+    d, i, stats = knn_search_pallas(query, db, k, tile_n=tile_n, margin=4,
+                                    binning=binning)
     np.testing.assert_array_equal(i, ref_i)
     assert stats["fallback_queries"] >= 1
     assert stats["fallback_genuine_misses"] >= 1
@@ -225,9 +236,10 @@ def test_wide_bin_geometry_matches_oracle(rng, bin_w, survivors):
     db = rng.normal(size=(9 * BIN_W + 45, 16)).astype(np.float32) * 20
     queries = rng.normal(size=(11, 16)).astype(np.float32) * 20
     ref_d, ref_i = _oracle(db, queries, 7)
+    # bin_w only shapes LANE-mode binning (inert in grouped mode)
     d, i, stats = knn_search_pallas(
         queries, db, 7, tile_n=4 * BIN_W, margin=8, bin_w=bin_w,
-        survivors=survivors,
+        survivors=survivors, binning="lane",
     )
     np.testing.assert_array_equal(i, ref_i)
     np.testing.assert_allclose(d, ref_d, rtol=5e-5)
@@ -240,24 +252,35 @@ def test_multi_block_output_lanes_match_oracle(rng):
     # kernel run at out_w = 256
     from knn_tpu.ops.pallas_knn import _geometry
 
-    assert _geometry(4 * BIN_W, BIN_W, 64) == (4, 8, 128, 128)  # capped
-    assert _geometry(16 * BIN_W, BIN_W, 2) == (16, 2, 128, 128)
-    assert _geometry(32 * BIN_W, BIN_W, 8) == (32, 8, 256, 128)
-    assert _geometry(160 * BIN_W, BIN_W, 1) == (160, 1, 256, 256)
+    assert _geometry(4 * BIN_W, BIN_W, 64, "lane") == (4, 8, 128, 128)
+    assert _geometry(16 * BIN_W, BIN_W, 2, "lane") == (16, 2, 128, 128)
+    assert _geometry(32 * BIN_W, BIN_W, 8, "lane") == (32, 8, 256, 128)
+    assert _geometry(160 * BIN_W, BIN_W, 1, "lane") == (160, 1, 256, 256)
+    # grouped: always 128 lane-bins; out_w = survivors * 128; bin_w inert
+    assert _geometry(4 * BIN_W, BIN_W, None, "grouped") == (128, 2, 256, 128)
+    assert _geometry(32 * BIN_W, BIN_W, 64, "grouped") == (128, 8, 1024, 128)
+    assert _geometry(160 * BIN_W, 2 * BIN_W, 1, "grouped") == (128, 1, 128, 128)
 
-    # out_w = 256 kernel run: 32 bins x 8 survivors per tile
+    # out_w = 256 LANE-mode kernel run: 32 bins x 8 survivors per tile
+    # (explicit binning: the grouped default would change the geometry
+    # and stop exercising the round-2 multi-block lowering regression)
     db = rng.normal(size=(2 * 32 * BIN_W + 77, 8)).astype(np.float32) * 5
     queries = rng.normal(size=(5, 8)).astype(np.float32) * 5
     k = 5
     ref_d, ref_i = _oracle(db, queries, k)
     d, i, _ = knn_search_pallas(queries, db, k, tile_n=32 * BIN_W, margin=6,
-                                survivors=8)
+                                survivors=8, binning="lane")
     np.testing.assert_array_equal(i, ref_i)
     np.testing.assert_allclose(d, ref_d, rtol=5e-5)
 
-    # bound_w = 256 kernel run: 160 bins per tile
+    # bound_w = 256 lane-mode kernel run: 160 bins per tile
     d, i, _ = knn_search_pallas(queries, db, k, tile_n=160 * BIN_W, margin=6,
-                                survivors=1)
+                                survivors=1, binning="lane")
+    np.testing.assert_array_equal(i, ref_i)
+
+    # grouped multi-block out_w: 8 survivors -> out_w = 1024 (8 blocks)
+    d, i, _ = knn_search_pallas(queries, db, k, tile_n=32 * BIN_W, margin=6,
+                                survivors=8, binning="grouped")
     np.testing.assert_array_equal(i, ref_i)
 
 
