@@ -396,22 +396,16 @@ def main() -> None:
     cpu_qps, cpu_per_q_s, oracle_idx = _cpu_baseline(db, sub)
     _vlog(f"cpu baseline done: {cpu_qps and round(cpu_qps, 2)} q/s")
 
-    global METRIC
     metric_label = METRIC
     if METRIC == "cosine":
-        # cosine distance on row-normalized vectors IS squared-L2 ranking
-        # (||q̂-t̂||² = 2(1-q̂·t̂)), so normalizing once up front lets the
-        # glove config run the whole certified-exact machinery; the CPU
-        # oracle above ranked true cosine on the raw data, so the recall
-        # check still validates the equivalence end-to-end
-        def _rownorm(x):
-            n64 = np.linalg.norm(x.astype(np.float64), axis=-1, keepdims=True)
-            return (x / np.maximum(n64, 1e-24)).astype(np.float32)
-
-        db, queries = _rownorm(db), _rownorm(queries)
-        sub = queries[:CPU_QUERIES]
-        METRIC = "l2"
-        metric_label = "cosine (as normalized l2)"
+        # the library handles cosine natively now: ShardedKNN normalizes
+        # the db rows at placement and search_certified runs the whole
+        # certified-exact machinery on unit vectors (the round-3 harness
+        # did this normalization trick itself; VERDICT r3 item 4 moved it
+        # into the library).  The CPU oracle above ranked true cosine on
+        # the raw data, so the recall check validates the equivalence
+        # end-to-end.
+        metric_label = "cosine (certified via unit-vector l2)"
 
     global DTYPE
     if oracle_idx is None and "KNN_BENCH_DTYPE" not in os.environ:
@@ -423,7 +417,7 @@ def main() -> None:
     mesh = make_mesh()  # all devices; (1,1) on a single chip
     tile = min(TILE, N)
     coarse_k = min(K + MARGIN, N)
-    certifiable = METRIC in ("l2", "sql2", "euclidean")
+    certifiable = METRIC in ("l2", "sql2", "euclidean", "cosine")
 
     modes = os.environ.get(
         "KNN_BENCH_MODES",
@@ -527,8 +521,16 @@ def main() -> None:
             survivors=PALLAS_SURVIVORS, final_select=PALLAS_FINAL,
             binning=PALLAS_BINNING, final_recall_target=PALLAS_FINAL_RT,
         )
+        pb_queries = queries
+        if METRIC == "cosine":
+            # the pallas program computes l2 against the unit-normalized
+            # placed db; search_certified normalizes queries internally,
+            # so this timing probe must feed it the same normalized form
+            from knn_tpu.parallel.sharded import _row_normalize_f64
+
+            pb_queries = _row_normalize_f64(queries)
         t0 = time.perf_counter()
-        qp, _ = prog._place_queries(queries)
+        qp, _ = prog._place_queries(pb_queries)
         _jax.block_until_ready(qp)
         h2d = time.perf_counter() - t0
         norm_op = np.float32(prog._db_norm_max())
@@ -545,7 +547,9 @@ def main() -> None:
         xfer = time.perf_counter() - t0
         gi, tight, badf, dk = unpack_certified(packed[:NQ], K, w, True)
         t0 = time.perf_counter()
-        rank_correct_runs(gi, tight, K, queries, db,
+        # the certified space's arrays: for cosine that is the unit-
+        # normalized pair (prog's host train is the placed/normalized db)
+        rank_correct_runs(gi, tight, K, pb_queries, prog._host_train(),
                           d32k=dk.astype(np.float64))
         host = time.perf_counter() - t0
         mb = packed.nbytes / 1e6

@@ -30,6 +30,7 @@ __version__ = "0.1.0"
 # symbol -> defining submodule; resolved on first attribute access
 _EXPORTS = {
     "pairwise_distance": "knn_tpu.ops.distance",
+    "metric_values": "knn_tpu.ops.distance",
     "pairwise_sq_l2": "knn_tpu.ops.distance",
     "pairwise_l1": "knn_tpu.ops.distance",
     "pairwise_cosine": "knn_tpu.ops.distance",

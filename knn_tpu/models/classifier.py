@@ -85,9 +85,10 @@ class KNNClassifier:
         kneighbors runs the sharded SPMD program (parallel.ShardedKNN).
         None = single-device jitted path (identical results).
       merge: db-axis merge strategy when meshed ('allgather' | 'ring').
-      mode: 'exact' | 'certified' (meshed, l2 only) — certified runs the
-        coarse+certificate pipeline; neighbor indices (and hence labels)
-        are still exact.
+      mode: 'exact' | 'certified' (meshed, l2 or cosine) — certified runs
+        the coarse+certificate pipeline; neighbor indices (and hence
+        labels) are still exact (cosine: for the f32-row-normalized
+        problem, see ShardedKNN.search_certified).
       selector: coarse selector for certified mode ('approx' | 'pallas' |
         'exact').  The pallas selector returns f32-accurate kneighbors
         distances (see ShardedKNN.search_certified); the others float64.
@@ -111,8 +112,10 @@ class KNNClassifier:
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "certified" and mesh is None:
             raise ValueError("mode='certified' needs a mesh (make_mesh(1, 1) is fine)")
-        if mode == "certified" and metric not in ("l2", "sql2", "euclidean"):
-            raise ValueError("mode='certified' supports the l2 metric only")
+        if mode == "certified" and metric not in ("l2", "sql2", "euclidean",
+                                                  "cosine"):
+            raise ValueError(
+                "mode='certified' supports the l2 and cosine metrics only")
         self.k = k
         self.metric = metric
         self.num_classes = num_classes
@@ -215,30 +218,42 @@ class KNNClassifier:
             1,
         )
 
-    def kneighbors(self, Q) -> Tuple[jax.Array, jax.Array]:
-        """(distances, indices) of the k nearest neighbors per query."""
+    def kneighbors(self, Q, *, return_sqrt: bool = False
+                   ) -> Tuple[jax.Array, jax.Array]:
+        """(distances, indices) of the k nearest neighbors per query.
+
+        L2-family distances are SQUARED by default (the reference's
+        monotone sqrt, knn_mpi.cpp:48, is dropped for ranking);
+        ``return_sqrt=True`` returns true Euclidean values matching
+        ``Euclidean_D`` / sklearn."""
         self._require_fit()
         Q = self._prep_queries(Q)
         if self._program is not None:
             if self.mode == "certified":
                 d, i, _ = self._program.search_certified(
                     np.asarray(Q), selector=self.selector,
-                    batch_size=self.batch_size,
+                    batch_size=self.batch_size, return_sqrt=return_sqrt,
                 )
                 return jnp.asarray(d), jnp.asarray(i)
-            return self._batched(Q, self._program.search, 2)
-        return self._batched(
-            Q,
-            lambda c: knn_kneighbors(
-                self._train,
-                c,
-                k=self.k,
-                metric=self.metric,
-                train_tile=self.train_tile,
-                compute_dtype=self.compute_dtype,
-            ),
-            2,
-        )
+            d, i = self._batched(Q, self._program.search, 2)
+        else:
+            d, i = self._batched(
+                Q,
+                lambda c: knn_kneighbors(
+                    self._train,
+                    c,
+                    k=self.k,
+                    metric=self.metric,
+                    train_tile=self.train_tile,
+                    compute_dtype=self.compute_dtype,
+                ),
+                2,
+            )
+        if return_sqrt:
+            from knn_tpu.ops.distance import metric_values
+
+            d = metric_values(d, self.metric)
+        return d, i
 
     def score(self, Q, y) -> float:
         """Accuracy — ``acc_calc`` (knn_mpi.cpp:69-84)."""

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from knn_tpu.ops.metrics import METRICS  # re-exported: names for pairwise_distance
@@ -131,3 +132,19 @@ def pairwise_distance(
     if m == "dot":
         return pairwise_dot(queries, train, compute_dtype=compute_dtype)
     raise ValueError(f"unknown metric {metric!r}; expected one of {METRICS}")
+
+
+def metric_values(d, metric: str = "l2"):
+    """Ranking scores -> reference/sklearn metric VALUES.
+
+    Every l2-family search surface in this package ranks by SQUARED L2
+    (the monotone sqrt at knn_mpi.cpp:48 is dropped for speed); consumers
+    expecting ``Euclidean_D``'s actual values (or sklearn's) apply this
+    to the returned distances.  L2 family -> ``sqrt(max(d, 0))`` (the
+    clamp absorbs tiny negative expanded-square float error); every
+    other metric's scores already ARE its values.  Works on numpy and
+    jax arrays alike."""
+    if metric.lower() in ("l2", "sql2", "euclidean"):
+        xp = jnp if isinstance(d, jax.Array) else np
+        return xp.sqrt(xp.maximum(d, 0))
+    return d

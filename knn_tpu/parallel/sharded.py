@@ -195,6 +195,67 @@ def _knn_program(
     )
 
 
+#: bounded-retry policy for transient device failures inside long sweeps
+#: (SURVEY §5 failure row; the same per-batch unit streaming.py uses).
+#: ValueError/TypeError are caller bugs and never retried.  Waits double
+#: per attempt so the window can outlast a real hiccup, not just an
+#: instantaneous glitch.
+_RETRY_ATTEMPTS = 3
+_RETRY_WAIT_S = 0.5
+
+
+def _retry_wait(attempt: int) -> None:
+    import time
+
+    time.sleep(_RETRY_WAIT_S * (2 ** attempt))
+
+
+def _retry_transient(fn, what: str = "device call",
+                     attempts: int = _RETRY_ATTEMPTS):
+    """Call ``fn`` with bounded retries on transient (non-ValueError/
+    TypeError) failures — the dispatch-side half of the retry story."""
+    err = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except (ValueError, TypeError):
+            raise  # caller bug: retry cannot help
+        except Exception as e:  # transient device/runtime failure
+            err = e
+            if attempt + 1 < attempts:
+                _retry_wait(attempt)
+    raise RuntimeError(f"{what} failed after {attempts} attempts") from err
+
+
+def _fetch_or_redispatch(out, redo, what: str = "device fetch",
+                         attempts: int = _RETRY_ATTEMPTS):
+    """``np.asarray(out)``, re-dispatching via ``redo()`` on transient
+    failure — the fetch-side half: async device errors surface at the
+    host transfer, after the original dispatch call already returned."""
+    try:
+        return np.asarray(out)
+    except (ValueError, TypeError):
+        raise
+    except Exception as e:
+        err = e
+    for attempt in range(attempts - 1):
+        _retry_wait(attempt)
+        try:
+            return np.asarray(redo())
+        except (ValueError, TypeError):
+            raise
+        except Exception as e:
+            err = e
+    raise RuntimeError(f"{what} failed after {attempts} attempts") from err
+
+
+def _row_normalize_f64(x: np.ndarray) -> np.ndarray:
+    """Unit rows, float64 norms -> float32 result (accuracy: the cast is
+    the only f32 rounding, ~2^-24 relative per entry)."""
+    n = np.linalg.norm(x.astype(np.float64), axis=-1, keepdims=True)
+    return (x / np.maximum(n, 1e-300)).astype(np.float32)
+
+
 class ShardedKNN:
     """A placed distributed-KNN program: the database is padded, sharded
     along the db axis, and transferred **once** at construction; every
@@ -223,6 +284,7 @@ class ShardedKNN:
     ):
         if merge not in _MERGES:
             raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
+        self._cosine_unit = False  # db rows normalized at placement?
         db_shards = mesh.shape[DB_AXIS]
         pre_placed = (
             isinstance(train, jax.Array)
@@ -254,6 +316,16 @@ class ShardedKNN:
                 raise ValueError("n_train is only for pre-placed arrays")
             if not isinstance(train, jax.Array):
                 train = np.asarray(train)  # host padding streams shards on placement
+            if metric == "cosine" and isinstance(train, np.ndarray):
+                # cosine distance on row-normalized vectors is squared L2
+                # (||q^-t^||^2 = 2(1-q^.t^)): normalizing ONCE at placement
+                # (float64 norms, f32 result) makes the whole certified-
+                # exact machinery available to cosine (search_certified),
+                # and pairwise_cosine's internal re-normalization is
+                # idempotent so plain search is unchanged.  Zero rows keep
+                # themselves (norm clamped).
+                train = _row_normalize_f64(train)
+                self._cosine_unit = True
             # host copy (unpadded) for certified-path float64 refinement
             self._train_host = train if isinstance(train, np.ndarray) else None
             # pad rows with a huge fill: every selector also masks them by
@@ -299,13 +371,18 @@ class ShardedKNN:
         return shard(qp, self.mesh, QUERY_AXIS), n_q
 
     def search(
-        self, queries: jax.Array, *, k: Optional[int] = None
+        self, queries: jax.Array, *, k: Optional[int] = None,
+        return_sqrt: bool = False,
     ) -> Tuple[jax.Array, jax.Array]:
         """(distances, global indices) [Q, k] of the k nearest database rows.
 
         ``k`` overrides the constructor's k for this call (e.g. fetching
         k+margin candidates for host refinement) while reusing the same
         device placement; each distinct k compiles its own cached program.
+
+        L2-family distances are SQUARED by default (ranking-equivalent,
+        the monotone sqrt at knn_mpi.cpp:48 dropped); ``return_sqrt=True``
+        returns true Euclidean values matching the reference / sklearn.
         """
         k = self.k if k is None else k
         shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
@@ -316,7 +393,11 @@ class ShardedKNN:
             self.mesh, k, self.metric, self.merge, self.n_train,
             self.train_tile, self._dtype_key,
         )
-        d, i = fn(qp, self._tp)
+        d, i = _retry_transient(lambda: fn(qp, self._tp), "search dispatch")
+        if return_sqrt:
+            from knn_tpu.ops.distance import metric_values
+
+            d = metric_values(d, self.metric)
         return d[:n_q], i[:n_q]
 
     # -- certified-exact path (ops.certified, distributed) -----------------
@@ -354,10 +435,15 @@ class ShardedKNN:
         recall_target: Optional[float] = None,
         binning: str = "grouped",
         final_recall_target: Optional[float] = None,
+        return_sqrt: bool = False,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
-        Returns (dists_f64, idx, stats).  L2 only (the certificate is a
-        squared-L2 bound).  Two certificate strategies by ``selector``:
+        Returns (dists_f64, idx, stats).  L2 and cosine (the certificate
+        is a squared-L2 bound; cosine runs it on unit vectors — rows are
+        normalized at placement, queries here — and is exact for the
+        f32-row-normalized problem, distances returned as 1-similarity).
+        L1 has no squared-L2-style bound and stays uncertified.  Two
+        certificate strategies by ``selector``:
 
         - ``"approx"`` / ``"exact"``: coarse top-(k+margin), float64 host
           refine, then a distributed count-below pass (psum over the db
@@ -400,13 +486,34 @@ class ShardedKNN:
         (None = its default 0.95; raise toward 0.9999 with a wider
         ``margin`` to push the fallback rate below 1%).
         """
-        if self.metric not in ("l2", "sql2", "euclidean"):
-            raise ValueError("search_certified supports the l2 metric only")
+        if self.metric == "cosine":
+            # runs the l2 certificate on unit vectors (db rows were
+            # normalized at placement): EXACT for the f32-row-normalized
+            # problem; returned distances are converted back to cosine
+            # values (1 - q^.t^ = ||q^-t^||^2 / 2) below.  L1 stays
+            # uncertified: the count-below / exclusion-bound certificates
+            # are squared-L2 inequalities and |q-t|_1 admits no
+            # gram-matrix form to bound (SURVEY §7 step 1).
+            if not self._cosine_unit:
+                raise ValueError(
+                    "cosine search_certified needs the database normalized "
+                    "at placement; construct ShardedKNN from a host array "
+                    "(pre-placed arrays arrive already sharded, so "
+                    "row-normalize them and use metric='l2' instead)"
+                )
+        elif self.metric not in ("l2", "sql2", "euclidean"):
+            raise ValueError(
+                "search_certified supports the l2 and cosine metrics only")
         if selector not in SELECTORS:
             raise ValueError(f"unknown selector {selector!r}; expected {SELECTORS}")
         from knn_tpu.ops.certified import repair_uncertified
 
         q_np = np.asarray(queries, dtype=np.float32)
+        if self.metric == "cosine":
+            q_np = _row_normalize_f64(q_np)
+        # every certified stage runs in squared-L2 space (for cosine: on
+        # the unit vectors placed at construction / normalized above)
+        cert_metric = "l2" if self.metric == "cosine" else self.metric
         n_q = q_np.shape[0]
         shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
         # margin is bounded by both the db size and the per-shard rows the
@@ -444,7 +551,7 @@ class ShardedKNN:
         else:
             bad = self._certify_counted(
                 batches, bs, m, d, i, q_np, db_np, db_norm_max, selector,
-                recall_target=recall_target,
+                recall_target=recall_target, metric=cert_metric,
             )
 
         def _select(qb, widen):
@@ -454,7 +561,7 @@ class ShardedKNN:
             # must run in f32 (dtype_key None) even when the main path is
             # bf16 — certification_tolerance only covers f32 error
             exact = _knn_program(
-                self.mesh, widen, self.metric, self.merge, self.n_train,
+                self.mesh, widen, cert_metric, self.merge, self.n_train,
                 self.train_tile, None, "exact",
             )
             bq, _ = self._place_queries(qb)
@@ -475,11 +582,21 @@ class ShardedKNN:
         }
         if selector == "pallas":
             stats["rank_corrected_queries"] = n_corrected
+        if return_distances and self.metric == "cosine":
+            # unit-vector squared L2 -> cosine distance values, exactly
+            # (matches pairwise_cosine's 1 - similarity convention)
+            d *= 0.5
+        if return_distances and return_sqrt:
+            # true Euclidean values (knn_mpi.cpp:48 / sklearn convention);
+            # indices and certification are unaffected (monotone map)
+            from knn_tpu.ops.distance import metric_values
+
+            d = metric_values(d, self.metric)
         return (d if return_distances else None), i, stats
 
     def _certify_counted(
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, selector,
-        recall_target: Optional[float] = None,
+        recall_target: Optional[float] = None, metric: Optional[str] = None,
     ):
         """Two-pass certificate: coarse select + refine, then the
         distributed count-below program proves completeness.  Returns the
@@ -503,7 +620,7 @@ class ShardedKNN:
         n_q = q_np.shape[0]
         k = self.k
         coarse = _knn_program(
-            self.mesh, m, self.metric, self.merge, self.n_train,
+            self.mesh, m, metric or self.metric, self.merge, self.n_train,
             self.train_tile, self._dtype_key, selector,
             recall_target=recall_target,
         )
@@ -513,14 +630,18 @@ class ShardedKNN:
         coarse_out = []
         for lo, chunk, pad in batches:
             qp, _ = self._place_queries(chunk)
-            coarse_out.append((qp, coarse(qp, self._tp)))
+            coarse_out.append((
+                qp, _retry_transient(lambda q=qp: coarse(q, self._tp),
+                                     "coarse dispatch")))
 
         # stage 2: per batch — sync its candidates, float64 host refine
         # (overlapping later batches' device work), dispatch its count
         count_out = []
         for (lo, chunk, pad), (qp, (_, ci)) in zip(batches, coarse_out):
             take = bs - pad
-            ci = np.asarray(ci)[:take]
+            ci = _fetch_or_redispatch(
+                ci, lambda q=qp: coarse(q, self._tp)[1], "coarse fetch"
+            )[:take]
             m_avail = ci.shape[1]
             # refine ALL candidates: ranks k..m feed the gap search
             d_m, i_m = refine_exact(db_np, q_np[lo : lo + take], ci, m_avail)
@@ -555,15 +676,20 @@ class ShardedKNN:
             mid = np.where(has, 0.5 * (dj + d_js), dj + tol)
             thr_p = np.full(qp.shape[0], -np.inf, dtype=np.float32)
             thr_p[:take] = mid
+            thr_s = shard(thr_p, self.mesh, QUERY_AXIS)
             count_out.append((
-                lo, take, js,
-                count_fn(qp, self._tp, shard(thr_p, self.mesh, QUERY_AXIS)),
+                lo, take, js, qp, thr_s,
+                _retry_transient(lambda q=qp, t=thr_s: count_fn(q, self._tp, t),
+                                 "count dispatch"),
             ))
 
         # stage 3: collect certificates (count <= per-query rank bound)
         flagged = []
-        for lo, take, js, c in count_out:
-            over = np.asarray(c)[:take] > js
+        for lo, take, js, qp, thr_s, c in count_out:
+            c_np = _fetch_or_redispatch(
+                c, lambda q=qp, t=thr_s: count_fn(q, self._tp, t),
+                "count fetch")
+            over = c_np[:take] > js
             flagged.append(lo + np.flatnonzero(over))
         return np.concatenate(flagged) if flagged else np.empty(0, np.int64)
 
@@ -646,16 +772,20 @@ class ShardedKNN:
         outs = []
         for lo, chunk, pad in batches:
             qp, _ = self._place_queries(chunk)
-            outs.append(prog(qp, self._tp, norm_op))
+            outs.append((qp, _retry_transient(
+                lambda q=qp: prog(q, self._tp, norm_op), "pallas dispatch")))
 
         # stage 2: per batch — ONE fetch of the packed output (the relay
         # charges a fixed latency per transfer), then repair tie runs
         bad_mask = np.zeros(q_np.shape[0], dtype=bool)
         n_corrected = 0
-        for (lo, chunk, pad), packed in zip(batches, outs):
+        for (lo, chunk, pad), (qp, packed) in zip(batches, outs):
             take = bs - pad
+            packed_np = _fetch_or_redispatch(
+                packed, lambda q=qp: prog(q, self._tp, norm_op),
+                "pallas fetch")
             gi_np, tight_np, bad_np, dk_np = unpack_certified(
-                np.asarray(packed)[:take], k, w, want_distances
+                packed_np[:take], k, w, want_distances
             )
             dc, ic, n_c = rank_correct_runs(
                 gi_np, tight_np, k, q_np[lo : lo + take], db_np,

@@ -45,9 +45,10 @@ def feed(path):
         # same-value line minus its gate verdict/failure stamp must not
         # silently erase it); carry gate_note forward either way
         incumbent_annotated = "pallas_gate_ok" in cur or "gate_note" in cur
+        challenger_annotated = "pallas_gate_ok" in rec or "gate_note" in rec
         take = (rank(rec) > rank(cur)
                 or (rank(rec) == rank(cur)
-                    and ("pallas_gate_ok" in rec or not incumbent_annotated)))
+                    and (challenger_annotated or not incumbent_annotated)))
         if take:
             if "gate_note" in cur and "gate_note" not in rec:
                 rec = dict(rec, gate_note=cur["gate_note"])

@@ -97,5 +97,23 @@ def test_refit_without_mesh_drops_old_program(data):
 
 
 def test_certified_rejects_non_l2_at_construction():
-    with pytest.raises(ValueError, match="l2 metric only"):
+    with pytest.raises(ValueError, match="l2 and cosine"):
         KNNClassifier(metric="l1", mode="certified", mesh=object())
+
+
+def test_classifier_certified_cosine(rng):
+    # cosine + certified now reaches the classifier surface (it routes
+    # to ShardedKNN.search_certified's unit-vector l2 certificate)
+    from knn_tpu.parallel.mesh import make_mesh
+
+    import knn_tpu
+
+    X = (rng.normal(size=(400, 10)) * np.linspace(
+        0.5, 2, 400)[:, None]).astype(np.float32)
+    y = (np.arange(400) % 3).astype(np.int32)
+    Q = rng.normal(size=(11, 10)).astype(np.float32)
+    cert = knn_tpu.KNNClassifier(k=5, metric="cosine", mode="certified",
+                                 mesh=make_mesh(1, 1)).fit(X, y)
+    plain = knn_tpu.KNNClassifier(k=5, metric="cosine").fit(X, y)
+    np.testing.assert_array_equal(
+        np.asarray(cert.predict(Q)), np.asarray(plain.predict(Q)))

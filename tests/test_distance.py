@@ -63,3 +63,46 @@ def test_dispatch_names(qt):
         assert d.shape == (q.shape[0], t.shape[0])
     with pytest.raises(ValueError):
         distance.pairwise_distance(jnp.asarray(q), jnp.asarray(t), "hamming")
+
+
+def test_metric_values_sqrt_matches_reference_euclidean(qt):
+    # VALUE-level parity with Euclidean_D (knn_mpi.cpp:48): sqrt of the
+    # squared-L2 ranking score must equal sqrt(sum (q-t)^2) in float64,
+    # and a tiny negative expanded-square artifact must clamp to 0
+    q, t = qt
+    ref = np.sqrt(
+        ((q.astype(np.float64)[:, None] - t.astype(np.float64)[None]) ** 2
+         ).sum(-1))
+    got = np.asarray(distance.metric_values(
+        distance.pairwise_sq_l2(jnp.asarray(q), jnp.asarray(t)), "l2"))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-4)
+    assert distance.metric_values(np.float32(-1e-7), "euclidean") == 0.0
+    # non-l2 metrics pass through untouched
+    d1 = distance.pairwise_l1(jnp.asarray(q), jnp.asarray(t))
+    np.testing.assert_array_equal(
+        np.asarray(distance.metric_values(d1, "l1")), np.asarray(d1))
+
+
+def test_search_return_sqrt_value_parity(rng):
+    # kneighbors/search/search_certified return true Euclidean VALUES
+    # under return_sqrt=True, matching the float64 oracle
+    import knn_tpu
+    from knn_tpu.parallel.mesh import make_mesh
+    from knn_tpu.parallel.sharded import ShardedKNN
+
+    db = (rng.random((600, 16)) * 20).astype(np.float32)
+    q = (rng.random((12, 16)) * 20).astype(np.float32)
+    d64 = np.sqrt(((db.astype(np.float64)[None] -
+                    q.astype(np.float64)[:, None]) ** 2).sum(-1))
+    oracle = np.sort(d64, axis=-1)[:, :5]
+
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=5)
+    ds, _ = prog.search(q, return_sqrt=True)
+    np.testing.assert_allclose(np.asarray(ds), oracle, rtol=2e-4)
+    dc, _, _ = prog.search_certified(q, margin=6, return_sqrt=True)
+    np.testing.assert_allclose(dc, oracle, rtol=2e-4)
+
+    clf = knn_tpu.KNNClassifier(k=5)
+    clf.fit(db, (np.arange(600) % 3).astype(np.int32))
+    dk, _ = clf.kneighbors(q, return_sqrt=True)
+    np.testing.assert_allclose(np.asarray(dk), oracle, rtol=2e-4)

@@ -89,3 +89,85 @@ def test_pre_placed_n_train_masks_pad_rows(rng):
         ShardedKNN(placed, mesh=mesh, k=4, n_train=15)
     with pytest.raises(ValueError, match="only for pre-placed"):
         ShardedKNN(db, mesh=mesh, k=4, n_train=13)
+
+
+def test_multihost_real_processes_bitwise_parity(rng, tmp_path):
+    """VERDICT r3 item 3: execute the multi-host path with REAL OS
+    processes — 2 jax.distributed CPU processes (Gloo collectives over
+    DCN's stand-in), each holding only its own db slice — and assert the
+    assembled ShardedKNN search is bitwise-equal to single-process.
+    This is the analogue of the reference actually running under
+    ``mpiexec -n N`` (knn_mpi.cpp:123-125)."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    child = tmp_path / "mh_child.py"
+    child.write_text(textwrap.dedent("""
+        import sys, json
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        pid, n_proc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+        from knn_tpu.parallel import multihost
+        from knn_tpu.parallel.mesh import DB_AXIS
+        from knn_tpu.parallel.sharded import ShardedKNN
+
+        multihost.initialize(coordinator_address=f"localhost:{port}",
+                             num_processes=n_proc, process_id=pid)
+        assert jax.process_count() == n_proc
+        rng = np.random.default_rng(0)
+        db = (rng.random((64, 8)) * 10).astype(np.float32)
+        q = (rng.random((6, 8)) * 10).astype(np.float32)
+        mesh = multihost.global_mesh(1, n_proc)
+        sl = multihost.process_row_slice(64)
+        placed = multihost.shard_across_hosts(db[sl], mesh, DB_AXIS)
+        prog = ShardedKNN(placed, mesh=mesh, k=5)
+        d, i = prog.search(q)
+        print("RESULT " + json.dumps({
+            "pid": pid, "n_dev": len(jax.devices()),
+            "i": np.asarray(i).tolist(), "d": np.asarray(d).tolist()}),
+            flush=True)
+    """))
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(
+        os.environ,
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        JAX_PLATFORMS="cpu",
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(child), str(p), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for p in range(2)
+    ]
+    results = {}
+    for p, proc in enumerate(procs):
+        out, err = proc.communicate(timeout=180)
+        assert proc.returncode == 0, f"process {p} failed:\n{err[-2000:]}"
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")][-1]
+        results[p] = json.loads(line[len("RESULT "):])
+
+    # both processes span the global 2-device mesh and agree exactly
+    assert results[0]["n_dev"] == results[1]["n_dev"] == 2
+    assert results[0]["i"] == results[1]["i"]
+    assert results[0]["d"] == results[1]["d"]
+
+    # bitwise parity with the single-process placement (same seeded data)
+    data_rng = np.random.default_rng(0)
+    db = (data_rng.random((64, 8)) * 10).astype(np.float32)
+    q = (data_rng.random((6, 8)) * 10).astype(np.float32)
+    ref_d, ref_i = ShardedKNN(db, mesh=make_mesh(1, 2), k=5).search(q)
+    np.testing.assert_array_equal(
+        np.asarray(results[0]["i"]), np.asarray(ref_i))
+    np.testing.assert_array_equal(
+        np.asarray(results[0]["d"], dtype=np.float32), np.asarray(ref_d))

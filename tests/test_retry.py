@@ -1,0 +1,157 @@
+"""Bounded-retry fault injection for the sharded search paths (SURVEY §5
+failure row; VERDICT r3 item 8): a transient device error inside a long
+sweep must be retried per batch — on the dispatch side (the program call
+raises) and on the fetch side (the async error surfaces at np.asarray) —
+without killing the job or changing the exact result.  Caller bugs
+(ValueError/TypeError) must NOT be retried.
+"""
+
+import numpy as np
+import pytest
+
+from knn_tpu.parallel import sharded as sh
+from knn_tpu.parallel.mesh import make_mesh
+from knn_tpu.parallel.sharded import ShardedKNN
+
+
+def _oracle(db, queries, k):
+    d = ((db.astype(np.float64)[None] - queries.astype(np.float64)[:, None])
+         ** 2).sum(-1)
+    idx = np.lexsort(
+        (np.broadcast_to(np.arange(db.shape[0]), d.shape), d), axis=-1
+    )[:, :k]
+    return np.take_along_axis(d, idx, axis=-1), idx
+
+
+@pytest.fixture
+def data(rng):
+    db = (rng.random((500, 12)) * 20).astype(np.float32)
+    q = (rng.random((10, 12)) * 20).astype(np.float32)
+    return db, q
+
+
+class _FlakyArray:
+    """Defers to a real array but raises ONCE at host-fetch time —
+    models an async device failure surfacing at the transfer."""
+
+    def __init__(self, arr, state):
+        self._arr = arr
+        self._state = state
+
+    def __array__(self, dtype=None, copy=None):
+        if not self._state["tripped"]:
+            self._state["tripped"] = True
+            raise RuntimeError("injected async device failure")
+        a = np.asarray(self._arr)
+        return a.astype(dtype) if dtype is not None else a
+
+
+def test_search_retries_dispatch_failure(data, monkeypatch):
+    db, q = data
+    real = sh._knn_program
+    state = {"fails": 1}
+
+    def flaky_knn_program(*a, **kw):
+        prog = real(*a, **kw)
+
+        def wrapper(*pa, **pkw):
+            if state["fails"] > 0:
+                state["fails"] -= 1
+                raise RuntimeError("injected dispatch failure")
+            return prog(*pa, **pkw)
+
+        return wrapper
+
+    monkeypatch.setattr(sh, "_knn_program", flaky_knn_program)
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=5)
+    _, ref_i = _oracle(db, q, 5)
+    _, i = prog.search(q)
+    np.testing.assert_array_equal(np.asarray(i), ref_i)
+    assert state["fails"] == 0  # the injection actually fired
+
+
+def test_certified_counted_retries_fetch_failure(data, monkeypatch):
+    db, q = data
+    real = sh._knn_program
+    state = {"tripped": False}
+
+    def flaky_knn_program(*a, **kw):
+        prog = real(*a, **kw)
+
+        def wrapper(*pa, **pkw):
+            d, i = prog(*pa, **pkw)
+            if not state["tripped"]:
+                return d, _FlakyArray(i, state)
+            return d, i
+
+        return wrapper
+
+    monkeypatch.setattr(sh, "_knn_program", flaky_knn_program)
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=5)
+    _, ref_i = _oracle(db, q, 5)
+    d, i, stats = prog.search_certified(q, selector="exact", margin=6)
+    np.testing.assert_array_equal(i, ref_i)
+    assert state["tripped"]
+
+
+def test_certified_pallas_retries_fetch_failure(data, monkeypatch):
+    db, q = data
+    real = sh._pallas_certified_program
+    state = {"tripped": False}
+
+    def flaky_pallas_program(*a, **kw):
+        prog = real(*a, **kw)
+
+        def wrapper(*pa, **pkw):
+            out = prog(*pa, **pkw)
+            if not state["tripped"]:
+                return _FlakyArray(out, state)
+            return out
+
+        return wrapper
+
+    monkeypatch.setattr(sh, "_pallas_certified_program", flaky_pallas_program)
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=5)
+    _, ref_i = _oracle(db, q, 5)
+    d, i, stats = prog.search_certified(q, selector="pallas", margin=6)
+    np.testing.assert_array_equal(i, ref_i)
+    assert state["tripped"]
+
+
+def test_retry_gives_up_after_bounded_attempts(data, monkeypatch):
+    db, q = data
+    real = sh._knn_program
+
+    def always_broken(*a, **kw):
+        real(*a, **kw)  # keep compile cost honest
+
+        def wrapper(*pa, **pkw):
+            raise RuntimeError("permanently broken")
+
+        return wrapper
+
+    monkeypatch.setattr(sh, "_knn_program", always_broken)
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=5)
+    with pytest.raises(RuntimeError, match="failed after"):
+        prog.search(q)
+
+
+def test_caller_bugs_are_not_retried(data, monkeypatch):
+    db, q = data
+    real = sh._knn_program
+    calls = {"n": 0}
+
+    def buggy(*a, **kw):
+        real(*a, **kw)
+
+        def wrapper(*pa, **pkw):
+            calls["n"] += 1
+            raise ValueError("caller bug")
+
+        return wrapper
+
+    monkeypatch.setattr(sh, "_knn_program", buggy)
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=5)
+    with pytest.raises(ValueError, match="caller bug"):
+        prog.search(q)
+    assert calls["n"] == 1  # no retry on ValueError

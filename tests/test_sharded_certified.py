@@ -65,7 +65,7 @@ def test_predict_certified_matches_exact_predict(data):
 def test_certified_rejects_non_l2(data):
     db, queries = data
     prog = ShardedKNN(db, mesh=make_mesh(8, 1), k=3, metric="l1")
-    with pytest.raises(ValueError, match="l2 metric only"):
+    with pytest.raises(ValueError, match="l2 and cosine"):
         prog.search_certified(queries)
 
 
@@ -210,3 +210,53 @@ def test_certified_counted_margin_zero(rng):
     d, i, stats = prog.search_certified(queries, selector="exact", margin=0)
     np.testing.assert_array_equal(i, ref_i)
     np.testing.assert_allclose(d, ref_d, rtol=1e-9)
+
+
+def _cosine_oracle(db, queries, k):
+    """float64 cosine-distance lexicographic top-k on the f32 unit-
+    normalized problem (the space search_certified certifies)."""
+    def unit(x):
+        n = np.linalg.norm(x.astype(np.float64), axis=-1, keepdims=True)
+        return (x / np.maximum(n, 1e-300)).astype(np.float32)
+
+    dbn, qn = unit(db).astype(np.float64), unit(queries).astype(np.float64)
+    d = 1.0 - qn @ dbn.T
+    idx = np.lexsort((np.broadcast_to(np.arange(db.shape[0]), d.shape), d),
+                     axis=-1)[:, :k]
+    return np.take_along_axis(d, idx, axis=-1), idx
+
+
+@pytest.mark.parametrize("selector", ["exact", "approx", "pallas"])
+def test_certified_cosine_matches_oracle(rng, selector):
+    # VERDICT r4 item: cosine certified search through the LIBRARY path
+    # (db normalized at placement, queries at entry, l2 certificate on
+    # unit vectors) must match the float64 cosine oracle, with distances
+    # returned in 1-similarity units
+    db = (rng.normal(size=(900, 24)) * np.linspace(
+        0.5, 3.0, 900)[:, None]).astype(np.float32)  # varied row norms
+    queries = (rng.normal(size=(17, 24)) * 2).astype(np.float32)
+    k = 7
+    ref_d, ref_i = _cosine_oracle(db, queries, k)
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=k, metric="cosine")
+    d, i, stats = prog.search_certified(queries, selector=selector, margin=8)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-5, atol=1e-6)
+    assert stats["certified"] + stats["fallback_queries"] == 17
+
+
+def test_certified_cosine_plain_search_agrees(rng):
+    # placement-time normalization must not change plain cosine search
+    # (pairwise_cosine re-normalizes idempotently)
+    db = (rng.normal(size=(300, 12)) * 5).astype(np.float32)
+    queries = rng.normal(size=(9, 12)).astype(np.float32)
+    a = ShardedKNN(db, mesh=make_mesh(1, 2), k=5, metric="cosine")
+    _, ref_i = _cosine_oracle(db, queries, 5)
+    _, ia = a.search(queries)
+    np.testing.assert_array_equal(np.asarray(ia), ref_i)
+
+
+def test_certified_l1_still_rejected(rng):
+    db = rng.normal(size=(64, 8)).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(1, 1), k=3, metric="l1")
+    with pytest.raises(ValueError, match="l2 and cosine"):
+        prog.search_certified(rng.normal(size=(2, 8)).astype(np.float32))
