@@ -163,7 +163,22 @@ def pallas_proof():
 GATE_OK = None
 
 
-def run_bench(config):
+def run_bench(config, env_overrides=None):
+    saved = {}
+    for k, v in (env_overrides or {}).items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        _run_bench_inner(config)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _run_bench_inner(config):
     os.environ["KNN_BENCH_CONFIG"] = config
     sys.argv = ["bench.py"]
 
@@ -203,10 +218,13 @@ def run_bench(config):
 
 
 def kernel_ab():
-    """bf16x3 (three dots) vs bf16x3f (one fused 3x-contraction dot)
-    kernel-only A/B at the SIFT bench shape — decides the production
-    default.  TPU_SESSION_AB=1 enables."""
-    from knn_tpu.ops.pallas_knn import _bin_candidates
+    """Kernel-only A/B at the SIFT bench shape — decides the production
+    geometry.  Round 4: grouped (shuffle-free select) vs lane binning
+    across tile sizes, then the end-to-end certified coarse pass
+    (kernel + final select) for the winner, plus the lane control.
+    Returns KNN_BENCH_PALLAS_* overrides for the sift1m bench (None if
+    nothing was measured).  TPU_SESSION_AB=1 enables."""
+    from knn_tpu.ops.pallas_knn import _bin_candidates, local_certified_candidates
 
     rng = np.random.default_rng(0)
     db = jnp.asarray(rng.random((1_000_000, 128), dtype=np.float32) * 128)
@@ -216,29 +234,76 @@ def kernel_ab():
         # block_until_ready does NOT block through the axon relay
         # (pallas_proof.timeit, measured round 3): a tiny host fetch is
         # the only real fence
-        np.asarray(o[2][:1, :1]).ravel()
+        np.asarray(jax.tree_util.tree_leaves(o)[0][:1, :1]).ravel()
 
-    out = {}
-    for prec in ("bf16x3", "bf16x3f"):
+    def timeit(launch, label, out, key):
         try:
-            o = _bin_candidates(qs, db, block_q=128, tile_n=8192, bin_w=128,
-                                survivors=2, precision=prec, interpret=False)
-            fence(o)
+            fence(launch())
             ts = []
             for _ in range(3):
                 t0 = time.time()
-                o = _bin_candidates(qs, db, block_q=128, tile_n=8192,
-                                    bin_w=128, survivors=2, precision=prec,
-                                    interpret=False)
+                o = launch()
                 fence(o)
                 ts.append(time.time() - t0)
-            out[prec] = round(min(ts) * 1e3, 1)
-            log(f"  kernel A/B {prec}: {out[prec]} ms / 4096 queries")
+            out[key] = round(min(ts) * 1e3, 1)
+            log(f"  kernel A/B {label}: {out[key]} ms / 4096 queries")
         except Exception as e:
-            out[prec] = f"error: {str(e)[:120]}"
-            log(f"  kernel A/B {prec} FAILED: {str(e)[:120]}")
+            out[key] = f"error: {str(e)[:160]}"
+            log(f"  kernel A/B {label} FAILED: {str(e)[:160]}")
+
+    kern = {}
+    variants = [
+        ("lane_t8192", dict(binning="lane", tile_n=8192)),
+        ("grouped_t8192", dict(binning="grouped", tile_n=8192)),
+        ("grouped_t16384", dict(binning="grouped", tile_n=16384)),
+        ("grouped_t32768", dict(binning="grouped", tile_n=32768)),
+    ]
+    for key, kw in variants:
+        timeit(lambda kw=kw: _bin_candidates(
+            qs, db, block_q=128, bin_w=128, survivors=2,
+            precision="bf16x3", interpret=False, **kw), key, kern, key)
+
+    # end-to-end coarse pass (kernel + final select + rescore): the
+    # kernel winner under both final selects, plus the lane-t8192
+    # control so the artifact line carries the r3-vs-r4 comparison
+    measured = [k for k in kern if isinstance(kern[k], float)]
+    if not measured:
+        # nothing measured (e.g. relay flaked through the A/B window):
+        # record the failure explicitly and let the bench stage run the
+        # library defaults rather than an unmeasured "winner"
+        with open(OUT, "a") as f:
+            f.write(json.dumps({"kernel_ab_ms_per_4096": kern,
+                                "winner": None,
+                                "error": "all variants failed"}) + "\n")
+        log("  kernel A/B: ALL variants failed; bench runs library defaults")
+        return None
+    best_kern = min(measured, key=lambda k: kern[k])
+    best_kw = dict(variants)[best_kern]
+    e2e = {}
+    for fs in ("approx", "exact"):
+        timeit(lambda fs=fs: local_certified_candidates(
+            qs, db, m=128, block_q=128, final_select=fs,
+            interpret=False, **best_kw), f"{best_kern}_{fs}", e2e, fs)
+    timeit(lambda: local_certified_candidates(
+        qs, db, m=128, block_q=128, final_select="approx",
+        interpret=False, binning="lane", tile_n=8192),
+        "lane_t8192_approx (control)", e2e, "lane_control_approx")
+    # final select: measured winner, or bench.py's default when a probe
+    # failed (bench.py KNN_BENCH_PALLAS_FINAL default = "approx")
+    fsel = (min(("approx", "exact"), key=lambda k: e2e[k])
+            if all(isinstance(e2e.get(k), float) for k in ("approx", "exact"))
+            else "approx")
     with open(OUT, "a") as f:
-        f.write(json.dumps({"kernel_ab_ms_per_4096": out}) + "\n")
+        f.write(json.dumps({"kernel_ab_ms_per_4096": kern,
+                            "winner": best_kern,
+                            "winner_e2e_ms": e2e,
+                            "winner_final_select": fsel}) + "\n")
+    # the winner was measured at the SIFT shape (1M x 128): hand it ONLY
+    # to the sift1m bench — glove/gist keep their own tuned defaults
+    log(f"  sift1m bench will run {best_kw} final={fsel}")
+    return {"KNN_BENCH_PALLAS_BINNING": best_kw["binning"],
+            "KNN_BENCH_PALLAS_TILE": str(best_kw["tile_n"]),
+            "KNN_BENCH_PALLAS_FINAL": fsel}
 
 
 def main():
@@ -255,16 +320,20 @@ def main():
         with open(OUT, "a") as f:
             f.write(json.dumps({"pallas_proof": {"error": repr(e)}}) + "\n")
 
+    sift_overrides = None
     if os.environ.get("TPU_SESSION_AB") == "1":
         try:
-            kernel_ab()
+            sift_overrides = kernel_ab()
         except Exception as e:
             log(f"kernel A/B FAILED: {e!r}")
 
     configs = os.environ.get("TPU_SESSION_CONFIGS", "sift1m").split(",")
     for c in configs:
         try:
-            run_bench(c)
+            # the A/B winner was measured at the SIFT shape; other
+            # configs keep their own tuned defaults
+            run_bench(c, env_overrides=sift_overrides if c == "sift1m"
+                      else None)
         except Exception as e:
             import traceback
 
