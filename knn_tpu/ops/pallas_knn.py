@@ -392,12 +392,16 @@ def _bin_candidates(
     kwargs = {}
     if not interpret:
         # the [block_q, tile_n] f32 score tile + double-buffered db
-        # tiles overflow the default 16 MB scoped-vmem budget; 64 MB
-        # covers every production geometry (the TUNING_r03 variants that
-        # wanted more also measured slower and were dropped)
+        # tiles overflow the default 16 MB scoped-vmem budget.  64 MB
+        # covers the production geometries up to tile_n=16384; the
+        # budget scales with the score tile so tile_n=32768 (which
+        # halves the final-select width at survivors=3) can compile —
+        # v5e has 128 MB of VMEM, and a geometry that genuinely
+        # overflows still fails at compile time, never silently.
+        score_mb = block_q * tile_n * 4 // (1024 * 1024)
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
-            vmem_limit_bytes=64 * 1024 * 1024,
+            vmem_limit_bytes=max(64, 3 * score_mb + 24) * 1024 * 1024,
         )
     if precision in ("bf16x3", "bf16x3f"):
         # the high/low split of the db happens ONCE in XLA; the kernel
