@@ -394,8 +394,8 @@ def _bin_candidates(
         # the [block_q, tile_n] f32 score tile + double-buffered db
         # tiles overflow the default 16 MB scoped-vmem budget.  64 MB
         # covers the production geometries up to tile_n=16384; the
-        # budget scales with the score tile so tile_n=32768 (which
-        # halves the final-select width at survivors=3) can compile —
+        # budget scales with the score tile so tile_n=32768 (which cuts
+        # the final-select width 25% at survivors=3) can compile —
         # v5e has 128 MB of VMEM, and a geometry that genuinely
         # overflows still fails at compile time, never silently.
         score_mb = block_q * tile_n * 4 // (1024 * 1024)

@@ -312,7 +312,7 @@ def kernel_ab():
     with open(OUT, "a") as f:
         f.write(json.dumps({"kernel_ab_ms_per_4096": kern,
                             "winner": best_kern,
-                            "e2e_ms_final_approx": e2e,
+                            "e2e_ms": e2e,  # *_exact key = exact-final probe
                             "winner_final_select": fsel}) + "\n")
     # the winner was measured at the SIFT shape (1M x 128): hand it ONLY
     # to the sift1m bench — glove/gist keep their own tuned defaults

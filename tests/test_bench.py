@@ -67,3 +67,52 @@ def test_bench_bad_platform_still_emits_json_line():
     rec = json.loads(lines[0])
     assert rec["value"] is None
     assert "backend_init" in rec["error"]
+
+
+def test_probe_hang_is_killed_and_reported(monkeypatch, tmp_path):
+    """The round-3 failure mode: backend init hangs forever.  The probe
+    child must be KILLED at the timeout (parent lock untouched) and the
+    hang reported distinctly from a fast failure."""
+    import importlib
+    import bench as bench_mod
+
+    bench = importlib.reload(bench_mod)
+    # a child that sleeps forever stands in for the stale-claim hang
+    hang = tmp_path / "hang.py"
+    hang.write_text("import time\ntime.sleep(3600)\n")
+    real_exe = sys.executable
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        # substitute the hanging child for the probe's -c payload
+        return real_run([real_exe, str(hang)], **{
+            k: v for k, v in kw.items() if k != "env"})
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    import time as _time
+
+    t0 = _time.monotonic()
+    ok, err, hung = bench._probe_backend_subprocess(timeout=2)
+    took = _time.monotonic() - t0
+    assert not ok and hung
+    assert "hung" in err
+    assert took < 30  # the child was killed at the timeout, not awaited
+
+
+def test_probe_fast_failure_not_flagged_as_hang(monkeypatch, tmp_path):
+    import importlib
+    import bench as bench_mod
+
+    bench = importlib.reload(bench_mod)
+    boom = tmp_path / "boom.py"
+    boom.write_text("raise SystemExit('no accelerator')\n")
+    real_run = subprocess.run
+
+    def fake_run(cmd, **kw):
+        return real_run([sys.executable, str(boom)], **{
+            k: v for k, v in kw.items() if k != "env"})
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    ok, err, hung = bench._probe_backend_subprocess(timeout=30)
+    assert not ok and not hung
+    assert "rc=" in err
