@@ -65,9 +65,10 @@ def _env_opt_int(name):
     return int(os.environ[name]) if name in os.environ else None
 
 
-#: BASELINE.json configs 3/4/5.  ``certifiable`` = the count-below
-#: certificate applies (squared-L2 bound -> l2 only; cosine reports
-#: measured recall instead).
+#: BASELINE.json configs 3/4/5.  ``certifiable`` = the certificate
+#: machinery applies: l2 natively, cosine via the library's unit-vector
+#: l2 equivalence (ShardedKNN normalizes rows at placement).  L1 would
+#: not be (no squared-L2-style bound).
 CONFIGS = {
     "sift1m": dict(n=1_000_000, dim=128, k=100, metric="l2", dtype="bfloat16"),
     "glove": dict(n=1_183_514, dim=300, k=50, metric="cosine", dtype="bfloat16"),

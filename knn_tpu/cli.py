@@ -46,7 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--mode", default="exact", choices=("exact", "certified"),
         help="certified = fast approximate selection + float64 refinement + "
-        "count-below certificate (exact results, l2 only)",
+        "count-below certificate (exact results, l2 or cosine)",
     )
     p.add_argument(
         "--selector", default="approx", choices=("exact", "approx", "pallas"),
