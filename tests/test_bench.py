@@ -61,12 +61,34 @@ def test_bench_bad_platform_still_emits_json_line():
         "KNN_BENCH_PLATFORM": "bogus",
         "KNN_BENCH_INIT_ATTEMPTS": "1",
         "KNN_BENCH_INIT_TIMEOUT": "30",
+        "KNN_BENCH_FALLBACK_CPU": "0",  # default-on fallback would succeed
     }, timeout=120)
     assert rc == 1
     assert len(lines) == 1
     rec = json.loads(lines[0])
     assert rec["value"] is None
     assert "backend_init" in rec["error"]
+
+
+@pytest.mark.slow
+def test_bench_falls_back_to_cpu_by_default():
+    # the round-3 lesson: a flagged CPU number beats a null round record.
+    # A bogus accelerator platform + the default-on fallback must yield a
+    # real measurement honestly stamped backend=cpu.
+    rc, lines = _run({
+        "KNN_BENCH_PLATFORM": "bogus",
+        "KNN_BENCH_INIT_ATTEMPTS": "1",
+        "KNN_BENCH_INIT_TIMEOUT": "30",
+        "KNN_BENCH_N": "4000", "KNN_BENCH_NQ": "32", "KNN_BENCH_BATCH": "32",
+        "KNN_BENCH_K": "5", "KNN_BENCH_MARGIN": "4", "KNN_BENCH_TILE": "2048",
+        "KNN_BENCH_CPU_QUERIES": "8", "KNN_BENCH_RUNS": "1",
+        "KNN_BENCH_MODES": "exact",
+    })
+    assert rc == 0, lines
+    assert len(lines) == 1, lines  # the one-JSON-line stdout contract
+    rec = json.loads(lines[0])
+    assert rec["value"] > 0
+    assert rec["backend"] == "cpu"
 
 
 def test_probe_hang_is_killed_and_reported(monkeypatch, tmp_path):
