@@ -826,7 +826,9 @@ class ShardedKNN:
             self.mesh, self.k, self.num_classes, self.metric, self.merge,
             self.n_train, self.train_tile, self._dtype_key,
         )
-        return fn(qp, self._tp, self._labels)[:n_q]
+        out = _retry_transient(lambda: fn(qp, self._tp, self._labels),
+                               "predict dispatch")
+        return out[:n_q]
 
 
 def sharded_knn(

@@ -1,23 +1,41 @@
-"""Rebuild TPU_BENCH_r03.jsonl from the freshest bench line per config in
+"""Rebuild TPU_BENCH_r{N}.jsonl from the freshest bench line per config in
 tpu_bench_lines.jsonl, preferring lines measured under a GREEN compiled
 soundness gate (pallas_gate_ok true > unknown > false).  Prints what it
-chose so the round log shows the provenance."""
+chose so the round log shows the provenance.
+
+Usage: python scripts/refresh_bench_artifacts.py [round]   (default: 04)
+Seeds from the previous round's curated file so configs that did not
+re-measure this round survive with their provenance intact."""
 import json
 import os
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+try:
+    _r = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+except ValueError:
+    sys.exit(f"usage: {sys.argv[0]} [round-number]  (got {sys.argv[1]!r})")
+ROUND = f"{_r:02d}"
+PREV = f"{_r - 1:02d}"
 SRC = os.path.join(REPO, "tpu_bench_lines.jsonl")
-DST = os.path.join(REPO, "TPU_BENCH_r03.jsonl")
+DST = os.path.join(REPO, f"TPU_BENCH_r{ROUND}.jsonl")
+SEED = os.path.join(REPO, f"TPU_BENCH_r{PREV}.jsonl")
 
 
 def rank(rec):
-    # explicit true > gate-absent/unknown > explicit false.  A line with
-    # NO gate key ranks BELOW any line carrying an explicit verdict or a
-    # gate_note: a same-session line minus the annotation must never
-    # silently erase a recorded soundness-failure stamp (ADVICE r3).
+    # (backend tier, gate rank).  A CPU-fallback line (bench.py emits
+    # them by default when accelerator init fails) must NEVER supersede
+    # an accelerator line for the same config in the curated TPU
+    # artifact, regardless of gate state or freshness.
+    # Gate: explicit true > gate-absent/unknown > explicit false.  A
+    # line with NO gate key ranks BELOW any line carrying an explicit
+    # verdict or a gate_note: a same-session line minus the annotation
+    # must never silently erase a recorded soundness-failure stamp
+    # (ADVICE r3).
+    tier = 0 if rec.get("backend") == "cpu" else 1
     if "pallas_gate_ok" not in rec:
-        return -1 if "gate_note" not in rec else 0
-    return {True: 2, None: 1}.get(rec["pallas_gate_ok"], 0)
+        return (tier, -1 if "gate_note" not in rec else 0)
+    return (tier, {True: 2, None: 1}.get(rec["pallas_gate_ok"], 0))
 
 
 best = {}
@@ -55,9 +73,11 @@ def feed(path):
             best[cfg] = rec
 
 
-# seed with the currently-curated lines (configs whose session lines
-# predate tpu_bench_lines.jsonl's rotation must survive a refresh),
-# then let fresher session lines supersede them
+# seed with the previous round's curated lines, then this round's
+# current curation (configs whose session lines predate
+# tpu_bench_lines.jsonl's rotation must survive a refresh), then let
+# fresher session lines supersede them
+feed(SEED)
 feed(DST)
 feed(SRC)
 
@@ -66,4 +86,5 @@ with open(DST, "w") as f:
         f.write(json.dumps(best[cfg]) + "\n")
         r = best[cfg]
         print(f"{cfg}: value={r['value']} mode={r.get('mode')} "
+              f"backend={r.get('backend')} "
               f"gate={r.get('pallas_gate_ok')} recall={r.get('recall_at_k')}")
