@@ -11,8 +11,21 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _latest_round() -> int:
+    """Newest existing TPU_BENCH_r*.jsonl — the no-argument default, so
+    the script never silently rewrites a FROZEN older round's artifact
+    once a newer round file exists (the r03-hardcode trap)."""
+    import re
+
+    rounds = [int(m.group(1)) for f in os.listdir(REPO)
+              if (m := re.fullmatch(r"TPU_BENCH_r(\d+)\.jsonl", f))]
+    return max(rounds, default=4)
+
+
 try:
-    _r = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    _r = int(sys.argv[1]) if len(sys.argv) > 1 else _latest_round()
 except ValueError:
     sys.exit(f"usage: {sys.argv[0]} [round-number]  (got {sys.argv[1]!r})")
 ROUND = f"{_r:02d}"
@@ -61,14 +74,19 @@ def feed(path):
         # replace on a strictly greener gate; among equals, fresher wins
         # unless it would DROP an annotation the incumbent carries (a
         # same-value line minus its gate verdict/failure stamp must not
-        # silently erase it); carry gate_note forward either way
+        # silently erase it)
         incumbent_annotated = "pallas_gate_ok" in cur or "gate_note" in cur
         challenger_annotated = "pallas_gate_ok" in rec or "gate_note" in rec
+        equal = rank(rec) == rank(cur)
         take = (rank(rec) > rank(cur)
-                or (rank(rec) == rank(cur)
-                    and (challenger_annotated or not incumbent_annotated)))
+                or (equal and (challenger_annotated
+                               or not incumbent_annotated)))
         if take:
-            if "gate_note" in cur and "gate_note" not in rec:
+            # carry gate_note forward ONLY on an equal-rank replacement
+            # (same-quality line minus its stamp); a strictly greener
+            # win — e.g. the green re-measurement a red-gate note was
+            # waiting for — must NOT inherit the stale failure note
+            if equal and "gate_note" in cur and "gate_note" not in rec:
                 rec = dict(rec, gate_note=cur["gate_note"])
             best[cfg] = rec
 
