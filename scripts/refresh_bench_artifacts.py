@@ -3,31 +3,25 @@ tpu_bench_lines.jsonl, preferring lines measured under a GREEN compiled
 soundness gate (pallas_gate_ok true > unknown > false).  Prints what it
 chose so the round log shows the provenance.
 
-Usage: python scripts/refresh_bench_artifacts.py [round]   (default: 04)
-Seeds from the previous round's curated file so configs that did not
-re-measure this round survive with their provenance intact."""
+Usage: python scripts/refresh_bench_artifacts.py <round>
+The round argument is REQUIRED: any default would guess wrong in some
+window (a hardcoded round rewrites history once the round is frozen; a
+newest-file default does the same at the round boundary before the new
+round's file exists).  Seeds from the previous round's curated file so
+configs that did not re-measure this round survive with their
+provenance intact."""
 import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-
-def _latest_round() -> int:
-    """Newest existing TPU_BENCH_r*.jsonl — the no-argument default, so
-    the script never silently rewrites a FROZEN older round's artifact
-    once a newer round file exists (the r03-hardcode trap)."""
-    import re
-
-    rounds = [int(m.group(1)) for f in os.listdir(REPO)
-              if (m := re.fullmatch(r"TPU_BENCH_r(\d+)\.jsonl", f))]
-    return max(rounds, default=4)
-
-
 try:
-    _r = int(sys.argv[1]) if len(sys.argv) > 1 else _latest_round()
-except ValueError:
-    sys.exit(f"usage: {sys.argv[0]} [round-number]  (got {sys.argv[1]!r})")
+    _r = int(sys.argv[1])
+except (IndexError, ValueError):
+    sys.exit(f"usage: {sys.argv[0]} <round-number>   "
+             f"(explicit, so a stale default can never rewrite a frozen "
+             f"round's artifact)")
 ROUND = f"{_r:02d}"
 PREV = f"{_r - 1:02d}"
 SRC = os.path.join(REPO, "tpu_bench_lines.jsonl")
@@ -82,11 +76,14 @@ def feed(path):
                 or (equal and (challenger_annotated
                                or not incumbent_annotated)))
         if take:
-            # carry gate_note forward ONLY on an equal-rank replacement
-            # (same-quality line minus its stamp); a strictly greener
-            # win — e.g. the green re-measurement a red-gate note was
-            # waiting for — must NOT inherit the stale failure note
-            if equal and "gate_note" in cur and "gate_note" not in rec:
+            # gate_note carry rules: the note drops ONLY when the winner
+            # is explicitly GREEN (the re-measurement the note was
+            # waiting for).  An unknown-gate winner (rank above a red
+            # gate, but never actually gated) and an equal-rank
+            # replacement both inherit the stamp — a recorded soundness
+            # failure must never vanish without a green verdict
+            if ("gate_note" in cur and "gate_note" not in rec
+                    and rec.get("pallas_gate_ok") is not True):
                 rec = dict(rec, gate_note=cur["gate_note"])
             best[cfg] = rec
 
