@@ -138,3 +138,29 @@ def test_probe_fast_failure_not_flagged_as_hang(monkeypatch, tmp_path):
     ok, err, hung = bench._probe_backend_subprocess(timeout=30)
     assert not ok and not hung
     assert "rc=" in err
+
+
+@pytest.mark.slow
+def test_bench_glove_cosine_runs_certified_library_path():
+    # VERDICT r3 item 4: the cosine config must run the certified
+    # machinery through the LIBRARY (ShardedKNN normalizes at placement),
+    # not a harness-side normalize-and-relabel trick.  Tiny-shape glove
+    # on CPU: all three modes must report recall 1.0 vs the raw-cosine
+    # native oracle.
+    rc, lines = _run({
+        "KNN_BENCH_PLATFORM": "cpu",
+        "KNN_BENCH_CONFIG": "glove",
+        "KNN_BENCH_N": "3000", "KNN_BENCH_NQ": "48", "KNN_BENCH_BATCH": "24",
+        "KNN_BENCH_K": "7", "KNN_BENCH_MARGIN": "6", "KNN_BENCH_TILE": "1024",
+        "KNN_BENCH_CPU_QUERIES": "8", "KNN_BENCH_RUNS": "1",
+        "KNN_BENCH_DIM": "24", "KNN_BENCH_CPU_CACHE": "0",
+    })
+    assert rc == 0, lines
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["value"] > 0
+    assert rec["metric_fn"].startswith("cosine")
+    sels = rec["selectors"]
+    assert set(sels) == {"exact", "certified_approx", "certified_pallas"}
+    for name, sel in sels.items():
+        assert sel.get("recall_at_k") == 1.0, (name, sel)
