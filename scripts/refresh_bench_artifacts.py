@@ -65,16 +65,19 @@ def feed(path):
             best[cfg] = rec
             continue
         cur = best[cfg]
-        # replace on a strictly greener gate; among equals, fresher wins
-        # unless it would DROP an annotation the incumbent carries (a
-        # same-value line minus its gate verdict/failure stamp must not
-        # silently erase it)
+        # replace on a strictly greener gate; among equals this is a
+        # BEST-line curation: a fresher line wins only when it is at
+        # least as fast (a session may bench the same config twice, e.g.
+        # defaults first then the A/B winner — the slower of the two must
+        # not supersede just by being later), and never when it would
+        # DROP an annotation the incumbent carries (a same-value line
+        # minus its gate verdict/failure stamp must not silently erase it)
         incumbent_annotated = "pallas_gate_ok" in cur or "gate_note" in cur
         challenger_annotated = "pallas_gate_ok" in rec or "gate_note" in rec
         equal = rank(rec) == rank(cur)
         take = (rank(rec) > rank(cur)
-                or (equal and (challenger_annotated
-                               or not incumbent_annotated)))
+                or (equal and rec["value"] >= cur["value"]
+                    and (challenger_annotated or not incumbent_annotated)))
         if take:
             # gate_note carry rules: the note drops ONLY when the winner
             # is explicitly GREEN (the re-measurement the note was

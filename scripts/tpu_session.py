@@ -337,25 +337,36 @@ def main():
         with open(OUT, "a") as f:
             f.write(json.dumps({"pallas_proof": {"error": repr(e)}}) + "\n")
 
-    sift_overrides = None
-    if os.environ.get("TPU_SESSION_AB") == "1":
-        try:
-            sift_overrides = kernel_ab()
-        except Exception as e:
-            log(f"kernel A/B FAILED: {e!r}")
-
     configs = os.environ.get("TPU_SESSION_CONFIGS", "sift1m").split(",")
-    for c in configs:
+
+    def bench_safely(c, overrides=None):
         try:
-            # the A/B winner was measured at the SIFT shape; other
-            # configs keep their own tuned defaults
-            run_bench(c, env_overrides=sift_overrides if c == "sift1m"
-                      else None)
+            run_bench(c, env_overrides=overrides)
         except Exception as e:
             import traceback
 
             log(f"bench[{c}] FAILED: {e!r}")
             traceback.print_exc()
+
+    # risk ordering for a flaky tunnel: bank a library-defaults sift
+    # number right after the gate (the round's gating deliverable), THEN
+    # spend time on the A/B sweep and re-bench sift with the winner —
+    # the artifact refresher curates the best line either way
+    sift_overrides = None
+    if os.environ.get("TPU_SESSION_AB") == "1":
+        if "sift1m" in configs:
+            bench_safely("sift1m")
+        try:
+            sift_overrides = kernel_ab()
+        except Exception as e:
+            log(f"kernel A/B FAILED: {e!r}")
+        if sift_overrides and "sift1m" in configs:
+            bench_safely("sift1m", sift_overrides)
+        configs = [c for c in configs if c != "sift1m"]
+    for c in configs:
+        # non-sift configs always run their own tuned defaults (the A/B
+        # winner was measured at the SIFT shape)
+        bench_safely(c)
     log("session done; exiting cleanly to release the device claim")
 
 

@@ -75,7 +75,8 @@ def test_dim_chunking_matches_unchunked_scores(rng):
 
 
 @pytest.mark.parametrize("precision", ["highest", "bf16x3", "bf16x3f"])
-def test_exclusion_bound_is_sound(rng, precision):
+@pytest.mark.parametrize("binning", ["grouped", "lane"])
+def test_exclusion_bound_is_sound(rng, precision, binning):
     # THE property the one-pass certificate rests on: every db point
     # outside the candidate set must have kernel-space score >= lb
     # (within the precision mode's tolerance), and the returned d32 must
@@ -86,6 +87,7 @@ def test_exclusion_bound_is_sound(rng, precision):
     d32, idx, lb = local_certified_candidates(
         jnp.asarray(queries), jnp.asarray(db), m=m, block_q=8,
         tile_n=2 * BIN_W, precision=precision, interpret=True,
+        binning=binning,
     )
     d32 = np.asarray(d32)[:7]
     idx, lb = np.asarray(idx)[:7], np.asarray(lb)[:7]
