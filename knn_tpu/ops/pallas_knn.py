@@ -77,9 +77,14 @@ BIN_W = 128
 #: query rows per grid cell (VMEM: the [BLOCK_Q, TILE_N] f32 score tile;
 #: 128 fills the MXU's M dimension — measured best on v5e)
 BLOCK_Q = 128
-#: database rows per grid cell; with BIN_W=128 bins and 128-lane outputs,
-#: survivors = 128 // (TILE_N // BIN_W) = 2 per bin
-TILE_N = 8192
+#: database rows per grid cell.  16384 is the grouped-binning sweet spot
+#: at 1M rows: 128 lane-bins of 128 members per tile reproduce the
+#: round-3 candidate statistics (~0.3% three-share at survivors=2)
+#: while halving the final-select width vs tile 8192 (62 tiles x 256 =
+#: 15.9k candidates vs 123 x 256 = 31.5k); every production shape
+#: compile-checks for v5e at this tile (scripts/aot_compile_check.py).
+#: Lane-mode round-3 measurements used 8192 (TUNING_r03).
+TILE_N = 16384
 #: dim is processed in chunks so arbitrarily wide features (GIST's 960)
 #: never blow VMEM; qt accumulates in scratch across chunks
 DIM_CHUNK = 128
@@ -160,12 +165,47 @@ def _geometry(
         return n_bins, survivors, survivors * BIN_W, BIN_W
     n_bins = tile_n // bin_w
     if survivors is None:
-        survivors = max(1, min(128 // n_bins, MAX_SURVIVORS, bin_w))
+        # floor at 2: a 1-survivor kernel loses the second of two true
+        # neighbors sharing a bin — at 1M rows that is ~47% of queries
+        # (module docstring), the round-2 constant-fallback failure.
+        # Multi-block outputs are supported, so exceeding one 128-lane
+        # block is fine.
+        survivors = min(max(2, 128 // n_bins), MAX_SURVIVORS, bin_w)
     # the MAX_SURVIVORS cap applies to explicit requests too: each
     # survivor is an unrolled min/argmin sweep in the kernel trace
     survivors = min(survivors, MAX_SURVIVORS, bin_w)
     return n_bins, survivors, _round_up(n_bins * survivors, 128), _round_up(
         n_bins, 128)
+
+
+def effective_tile(
+    rows: int, tile_n: int, bin_w: int, survivors: Optional[int],
+    binning: str, min_width: int,
+) -> int:
+    """The db tile the kernel will actually run: capped to the (padded)
+    db, then HALVED until the total candidate width ``n_tiles * out_w``
+    covers ``min_width`` (= m+2 for certified callers) or the tile
+    bottoms out at ``bin_w``.  Mid-size databases would otherwise lose
+    candidate width to a large default tile (one 16384-tile over a 10k
+    db emits 256 lanes where two 8192-tiles emitted 512) and raise the
+    m+2-exceeds-width ValueError on margins that a smaller tile serves
+    fine.  ONE home for this arithmetic: local_certified_candidates and
+    parallel.sharded._pallas_setup must agree or their m-caps diverge."""
+    if tile_n % bin_w:
+        # the caller's REQUESTED tile must be well-formed (the halving
+        # below rounds its own internal steps, but never repairs an
+        # invalid request silently)
+        raise ValueError(
+            f"tile_n={tile_n} must be a multiple of bin_w={bin_w}")
+    eff = min(tile_n, max(bin_w, -(-rows // bin_w) * bin_w))
+
+    def width(t: int) -> int:
+        _, _, out_w, _ = _geometry(t, bin_w, survivors, binning)
+        return -(-rows // t) * out_w
+
+    while eff > bin_w and width(eff) < min_width:
+        eff = max(bin_w, -(-(eff // 2) // bin_w) * bin_w)
+    return eff
 
 
 def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
@@ -506,7 +546,8 @@ def local_certified_candidates(
     db shards and pmin's lb."""
     if interpret is None:
         interpret = not _on_tpu()
-    eff_tile = min(tile_n, max(bin_w, -(-t.shape[0] // bin_w) * bin_w))
+    eff_tile = effective_tile(t.shape[0], tile_n, bin_w, survivors,
+                              binning, m + 2)
     cd, ci, bounds = _bin_candidates(
         q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
         bin_w=bin_w, survivors=survivors, precision=precision,

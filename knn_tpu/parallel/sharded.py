@@ -706,7 +706,12 @@ class ShardedKNN:
         packed-output window, shared by :meth:`_certify_pallas` and
         bench.py's phase breakdown so they can never measure different
         programs or unpack different column layouts."""
-        from knn_tpu.ops.pallas_knn import BIN_W, TILE_N, _geometry
+        from knn_tpu.ops.pallas_knn import (
+            BIN_W,
+            TILE_N,
+            _geometry,
+            effective_tile,
+        )
 
         if precision not in ("bf16x3", "bf16x3f", "highest"):
             # "default" has no certified tolerance model (its matmul error
@@ -719,8 +724,12 @@ class ShardedKNN:
 
         eff_bin = bin_w or BIN_W
         shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
-        eff_tile = min(tile_n or TILE_N,
-                       max(eff_bin, -(-shard_rows // eff_bin) * eff_bin))
+        # same tile the kernel will pick (ONE home for the arithmetic:
+        # ops.pallas_knn.effective_tile), so the m-cap below matches the
+        # kernel's real candidate width
+        eff_tile = effective_tile(shard_rows, tile_n or TILE_N, eff_bin,
+                                  survivors, binning,
+                                  min(self.k + margin, shard_rows) + 2)
         _, _, out_w, _ = _geometry(eff_tile, eff_bin, survivors, binning)
         # m is bounded by the db, the per-shard rows, and the kernel's
         # per-shard candidate width minus the two slots the exclusion
