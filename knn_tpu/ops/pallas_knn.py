@@ -701,7 +701,13 @@ def knn_search_pallas(
 
     Convenience/test surface: every call places the database on the mesh
     afresh.  Repeated searches against the same database should construct
-    ``ShardedKNN`` once and call ``search_certified`` on it."""
+    ``ShardedKNN`` once and call ``search_certified`` on it.
+
+    Geometry note for SMALL databases: bin collision rates scale with
+    (bin_members / n)^2, so the default tile (128-member bins, tuned
+    for ~1M rows) falls back often below ~300k rows — still exact,
+    just slower.  Pass a smaller ``tile_n`` (e.g. ``n // 25`` rounded
+    to a multiple of 128) to restore a sub-1% fallback rate."""
     from knn_tpu.parallel.mesh import make_mesh
     from knn_tpu.parallel.sharded import ShardedKNN
 

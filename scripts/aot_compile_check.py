@@ -85,12 +85,13 @@ def main() -> int:
          (qs, db), dict(m=128, block_q=128, tile_n=16384,
                         final_select="exact", interpret=False,
                         binning="grouped")),
-        # non-128-dim configs: multi-chunk scratch accumulation
-        ("kernel grouped gist dim960", _bin_candidates, (qg, dbg),
-         dict(block_q=128, tile_n=8192, bin_w=128, survivors=2,
+        # non-128-dim configs: multi-chunk scratch accumulation, at the
+        # library-default tile (what a bench run with no overrides uses)
+        ("kernel grouped gist dim960 t16384", _bin_candidates, (qg, dbg),
+         dict(block_q=128, tile_n=16384, bin_w=128, survivors=2,
               precision="bf16x3", interpret=False, binning="grouped")),
-        ("certified grouped glove dim300", local_certified_candidates,
-         (qv, dbv), dict(m=78, block_q=128, tile_n=8192,
+        ("certified grouped glove dim300 t16384", local_certified_candidates,
+         (qv, dbv), dict(m=78, block_q=128, tile_n=16384,
                          final_select="approx", interpret=False,
                          binning="grouped")),
     ]

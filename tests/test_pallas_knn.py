@@ -312,3 +312,42 @@ def test_bit_mask_roundtrip(rng):
         assert packed.shape == (9, -(-b // 32))
         out = unpack_bits_u32(np.asarray(packed), b)
         np.testing.assert_array_equal(out, mask)
+
+
+def test_effective_tile_halves_for_midsize_dbs():
+    # the round-4 default tile (16384) must not starve mid-size dbs of
+    # candidate width: the shared halving helper shrinks the tile until
+    # n_tiles * out_w covers min_width (= m+2 for certified callers)
+    from knn_tpu.ops.pallas_knn import _geometry, effective_tile
+
+    # 10k rows, need 302 lanes: one 10112-tile gives 256 -> halve
+    t = effective_tile(10_000, 16384, BIN_W, None, "grouped", 302)
+    assert t % BIN_W == 0
+    n_tiles = -(-10_000 // t)
+    assert n_tiles * _geometry(t, BIN_W, None, "grouped")[2] >= 302
+
+    # huge db: no halving needed, the request is honored
+    assert effective_tile(1_000_000, 16384, BIN_W, None, "grouped", 130) \
+        == 16384
+    # tiny db: tile caps at the padded rows
+    assert effective_tile(200, 16384, BIN_W, None, "grouped", 4) == 256
+    # bottoms out at bin_w even when the width can never be met
+    assert effective_tile(100, 16384, BIN_W, None, "grouped", 10**6) == BIN_W
+    # an explicitly invalid request still raises, never silently repaired
+    with pytest.raises(ValueError, match="multiple"):
+        effective_tile(10_000, 100, BIN_W, None, "grouped", 10)
+    # lane mode: halving interacts with the survivors floor monotonically
+    t = effective_tile(10_000, 16384, BIN_W, None, "lane", 600)
+    n_tiles = -(-10_000 // t)
+    assert n_tiles * _geometry(t, BIN_W, None, "lane")[2] >= 600
+
+
+def test_default_tile_wide_margin_midsize_end_to_end(rng):
+    # regression: at the 16384 default tile a 10k-row db previously
+    # raised "m+2 exceeds ... survivors" for wide margins; the adaptive
+    # tile must keep the certified path exact end-to-end instead
+    db = rng.normal(size=(10_000, 12)).astype(np.float32) * 30
+    queries = rng.normal(size=(4, 12)).astype(np.float32) * 30
+    ref_d, ref_i = _oracle(db, queries, 60)
+    d, i, stats = knn_search_pallas(queries, db, 60, margin=240)
+    np.testing.assert_array_equal(i, ref_i)
