@@ -170,6 +170,35 @@ def _fail(stage, err, **extra):
     sys.exit(1)
 
 
+def _relay_ports_refused():
+    """True when this environment's accelerator relay is definitively
+    absent: the axon client dials 127.0.0.1:8083 (stateless device
+    enumeration) / :8082 (session) when AXON_POOL_SVC_OVERRIDE pins the
+    pool service to loopback, and a refused TCP connect there means no
+    tunnel exists — the client would otherwise spin its connect-retry
+    loop for ~25 minutes before surfacing UNAVAILABLE (measured during
+    the round-4 relay outage).  Only consulted for that specific
+    override, so generic environments keep the full probe."""
+    if os.environ.get("AXON_POOL_SVC_OVERRIDE") != "127.0.0.1":
+        return False
+    import socket
+
+    for port in (8083, 8082):
+        s = socket.socket()
+        s.settimeout(2.0)
+        try:
+            s.connect(("127.0.0.1", port))
+        except ConnectionRefusedError:
+            continue
+        except OSError:
+            return False  # filtered/timeout: can't conclude absence
+        else:
+            return False  # something listens: relay may be alive
+        finally:
+            s.close()
+    return True
+
+
 def _probe_backend_subprocess(timeout):
     """Attempt backend init in a KILLABLE child process.  Returns
     (ok, err): ok=True means a child saw jax.devices() succeed moments
@@ -242,6 +271,18 @@ def _init_backend():
     probe_err = None
     probe_ok = False
     for attempt in range(attempts):
+        if not os.environ.get("KNN_BENCH_PLATFORM") and _relay_ports_refused():
+            # no tunnel at all: don't burn the probe timeout spinning the
+            # client's 25-minute connect-retry loop — fail this attempt
+            # fast so the CPU fallback can land a line within any driver
+            # budget.  A quick re-check each attempt still catches a
+            # tunnel that comes up mid-loop.
+            probe_ok, probe_err, hung = (
+                False, "relay ports 8082/8083 refused (no tunnel)", False)
+            _vlog(f"backend probe {attempt + 1}/{attempts}: {probe_err}")
+            if attempt + 1 < attempts:
+                time.sleep(5.0)
+            continue
         _vlog(f"backend probe {attempt + 1}/{attempts} "
               f"(timeout {timeout}s) ...")
         probe_ok, probe_err, hung = _probe_backend_subprocess(timeout)
@@ -382,13 +423,15 @@ def main() -> None:
 
     global N, NQ, RUNS, CPU_QUERIES
     cpu_shrunk = False
-    if backend == "cpu":
-        # CPU fallback auto-shrink: the FULL sift1m sweep needs ~3 TFLOP
-        # per timed run — hours on this host's single core, so a driver
-        # timeout would turn the fallback line into nothing at all (the
-        # exact regression the fallback exists to prevent).  Explicit
-        # env overrides are respected; the shrink is visible in the
-        # metric name (n/dim/k are embedded) and flagged below.
+    if backend == "cpu" and os.environ.get("KNN_BENCH_PLATFORM") != "cpu":
+        # CPU FALLBACK auto-shrink (an explicitly requested
+        # KNN_BENCH_PLATFORM=cpu run is honored at full size): the FULL
+        # sift1m sweep needs ~3 TFLOP per timed run — hours on this
+        # host's single core, so a driver timeout would turn the
+        # fallback line into nothing at all (the exact regression the
+        # fallback exists to prevent).  Explicit env overrides are
+        # respected; the shrink is visible in the metric name (n/dim/k
+        # are embedded) and flagged below.
         def cap(env_key, value, limit):
             nonlocal cpu_shrunk
             if env_key in os.environ or value <= limit:
