@@ -284,6 +284,7 @@ class ShardedKNN:
     ):
         if merge not in _MERGES:
             raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
+        metric = metric.lower()  # dispatch below compares lowercase names
         self._cosine_unit = False  # db rows normalized at placement?
         db_shards = mesh.shape[DB_AXIS]
         pre_placed = (

@@ -61,7 +61,8 @@ class JobConfig:
     #: "exact" ranks every candidate in float32; "certified" uses a fast
     #: approximate selector + float64 refinement + the count-below
     #: certificate (ops.certified) — exact results, higher throughput at
-    #: scale.  Certified requires the l2 metric.
+    #: scale.  Certified supports the l2 and cosine metrics (cosine runs
+    #: the certificate on unit vectors; ShardedKNN.search_certified).
     mode: str = "exact"
     #: local-shard selector for certified mode: "approx" | "pallas" | "exact"
     selector: str = "approx"
@@ -69,7 +70,11 @@ class JobConfig:
     num_threads: int = 0  # 0 = hardware concurrency
 
     def __post_init__(self):
-        if self.metric.lower() not in METRICS:
+        # normalize case ONCE at the boundary: downstream dispatch
+        # (ShardedKNN's `metric == "cosine"` placement normalization,
+        # selector tables) compares lowercase names
+        self.metric = self.metric.lower()
+        if self.metric not in METRICS:
             raise ValueError(f"metric {self.metric!r} not in {METRICS}")
         if self.backend not in BACKENDS:
             raise ValueError(f"backend {self.backend!r} not in {BACKENDS}")
@@ -82,9 +87,10 @@ class JobConfig:
         if self.selector not in ("exact", "approx", "pallas"):
             raise ValueError(f"selector {self.selector!r} unknown")
         if self.mode == "certified" and self.metric.lower() not in (
-            "l2", "sql2", "euclidean"
+            "l2", "sql2", "euclidean", "cosine"
         ):
-            raise ValueError("mode='certified' requires the l2 metric")
+            raise ValueError(
+                "mode='certified' requires the l2 or cosine metric")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)

@@ -69,7 +69,11 @@ def test_certified_rejects_non_l2(data):
         prog.search_certified(queries)
 
 
-def test_pipeline_certified_mode(tmp_path, rng):
+@pytest.mark.parametrize("metric", ["l2", "cosine"])
+def test_pipeline_certified_mode(tmp_path, rng, metric):
+    # --mode certified end to end through run_job, both supported
+    # metrics (cosine's config gate opened in round 4): labels must
+    # match the exact pipeline and the stats invariants must hold
     from knn_tpu.data.datasets import make_blobs, save_labeled_csv, save_unlabeled_csv
 
     feats, labels = make_blobs(300, 6, 3, cluster_std=0.3, seed=9)
@@ -86,7 +90,7 @@ def test_pipeline_certified_mode(tmp_path, rng):
         return JobConfig(
             train_file=paths["train"], test_file=paths["test"], val_file=paths["val"],
             output_file=str(tmp_path / f"out_{mode}.csv"), k=5,
-            query_shards=4, db_shards=2, mode=mode,
+            metric=metric, query_shards=4, db_shards=2, mode=mode,
         )
 
     exact = run_job(cfg("exact"))
@@ -104,9 +108,13 @@ def test_pipeline_certified_mode(tmp_path, rng):
     assert cert.metrics()["certified_stats"] == stats
 
 
-def test_config_rejects_certified_non_l2():
-    with pytest.raises(ValueError, match="requires the l2"):
-        JobConfig(mode="certified", metric="cosine")
+def test_config_certified_metric_gate():
+    with pytest.raises(ValueError, match="requires the l2 or cosine"):
+        JobConfig(mode="certified", metric="l1")
+    JobConfig(mode="certified", metric="cosine")  # supported since round 4
+    # case is normalized at the config boundary so downstream dispatch
+    # (ShardedKNN's cosine placement normalization) can't be bypassed
+    assert JobConfig(mode="certified", metric="Cosine").metric == "cosine"
     with pytest.raises(ValueError, match="mode"):
         JobConfig(mode="fast")
     with pytest.raises(ValueError, match="selector"):
@@ -260,3 +268,5 @@ def test_certified_l1_still_rejected(rng):
     prog = ShardedKNN(db, mesh=make_mesh(1, 1), k=3, metric="l1")
     with pytest.raises(ValueError, match="l2 and cosine"):
         prog.search_certified(rng.normal(size=(2, 8)).astype(np.float32))
+
+
