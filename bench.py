@@ -98,6 +98,7 @@ try:
     PALLAS_TILE = _env_opt_int("KNN_BENCH_PALLAS_TILE")
     PALLAS_BIN_W = _env_opt_int("KNN_BENCH_PALLAS_BIN_W")
     PALLAS_SURVIVORS = _env_opt_int("KNN_BENCH_PALLAS_SURVIVORS")
+    PALLAS_BLOCK_Q = _env_opt_int("KNN_BENCH_PALLAS_BLOCK_Q")
     PALLAS_FINAL = os.environ.get("KNN_BENCH_PALLAS_FINAL", "approx")
     #: select-phase layout (ops.pallas_knn.BINNINGS): "grouped" = lane-
     #: indexed bins, shuffle-free select (round-4); "lane" = round-3
@@ -545,6 +546,7 @@ def main() -> None:
                     batch_size=PALLAS_BATCH,
                     precision=PALLAS_PRECISION, tile_n=PALLAS_TILE,
                     bin_w=PALLAS_BIN_W, survivors=PALLAS_SURVIVORS,
+                    block_q=PALLAS_BLOCK_Q,
                     final_select=PALLAS_FINAL, binning=PALLAS_BINNING,
                     final_recall_target=PALLAS_FINAL_RT,
                     return_distances=return_distances,
@@ -587,7 +589,8 @@ def main() -> None:
         # truth: ShardedKNN._pallas_setup)
         pp, m, w = prog._pallas_setup(
             MARGIN, PALLAS_TILE, PALLAS_PRECISION, bin_w=PALLAS_BIN_W,
-            survivors=PALLAS_SURVIVORS, final_select=PALLAS_FINAL,
+            survivors=PALLAS_SURVIVORS, block_q=PALLAS_BLOCK_Q,
+            final_select=PALLAS_FINAL,
             binning=PALLAS_BINNING, final_recall_target=PALLAS_FINAL_RT,
         )
         pb_queries = queries
@@ -662,7 +665,8 @@ def main() -> None:
         _, idx, g_stats = knn_search_pallas(
             g_q, g_db, g_k, precision=PALLAS_PRECISION,
             tile_n=PALLAS_TILE or TILE_N_DEFAULT, bin_w=PALLAS_BIN_W,
-            survivors=PALLAS_SURVIVORS, final_select=PALLAS_FINAL,
+            survivors=PALLAS_SURVIVORS, block_q=PALLAS_BLOCK_Q,
+            final_select=PALLAS_FINAL,
             binning=PALLAS_BINNING, final_recall_target=PALLAS_FINAL_RT,
         )
         return {
@@ -832,6 +836,7 @@ def main() -> None:
         "pallas_knobs": {
             "precision": PALLAS_PRECISION, "tile_n": PALLAS_TILE,
             "bin_w": PALLAS_BIN_W, "survivors": PALLAS_SURVIVORS,
+            "block_q": PALLAS_BLOCK_Q,
             "final_select": PALLAS_FINAL, "binning": PALLAS_BINNING,
             "final_recall_target": PALLAS_FINAL_RT, "batch": PALLAS_BATCH,
             "margin": MARGIN,

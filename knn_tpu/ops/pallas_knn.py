@@ -685,6 +685,7 @@ def knn_search_pallas(
     precision: str = "bf16x3",
     bin_w: Optional[int] = None,
     survivors: Optional[int] = None,
+    block_q: Optional[int] = None,
     final_select: str = "exact",
     binning: str = "grouped",
     final_recall_target: Optional[float] = None,
@@ -718,7 +719,8 @@ def knn_search_pallas(
     return prog.search_certified(
         np.asarray(queries, dtype=np.float32), margin=margin,
         selector="pallas", tile_n=tile_n, precision=precision,
-        bin_w=bin_w, survivors=survivors, final_select=final_select,
+        bin_w=bin_w, survivors=survivors, block_q=block_q,
+        final_select=final_select,
         binning=binning, final_recall_target=final_recall_target,
     )
 

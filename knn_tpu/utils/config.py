@@ -86,7 +86,7 @@ class JobConfig:
             raise ValueError(f"mode {self.mode!r} not in ('exact', 'certified')")
         if self.selector not in ("exact", "approx", "pallas"):
             raise ValueError(f"selector {self.selector!r} unknown")
-        if self.mode == "certified" and self.metric.lower() not in (
+        if self.mode == "certified" and self.metric not in (
             "l2", "sql2", "euclidean", "cosine"
         ):
             raise ValueError(

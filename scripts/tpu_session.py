@@ -263,10 +263,23 @@ def kernel_ab():
         # width, so it can only win on the E2E measurement below
         ("grouped_t32768_s3",
          dict(binning="grouped", tile_n=32768, survivors=3)),
+        # bigger query block: the grouped select's elementwise chains
+        # amortize over BQ; r3's block_q sweep was noise-level but that
+        # was with the shuffle-bound lane select
+        ("grouped_t16384_bq256",
+         dict(binning="grouped", tile_n=16384, survivors=2, block_q=256)),
     ]
-    for key, kw in variants:
-        timeit(lambda kw=kw: _bin_candidates(
-            qs, db, block_q=128, bin_w=128,
+    def variant_kw(key):
+        # ONE normalizer for a variant's full geometry (block_q default
+        # included) so the probes, the e2e stage, and the exported env
+        # can never measure different configurations
+        kw = dict(dict(variants)[key])
+        kw.setdefault("block_q", 128)
+        return kw
+
+    for key, _ in variants:
+        timeit(lambda kw=variant_kw(key): _bin_candidates(
+            qs, db, bin_w=128,
             precision="bf16x3", interpret=False, **kw), key, kern, key)
 
     measured = [k for k in kern if isinstance(kern[k], float)]
@@ -285,11 +298,13 @@ def kernel_ab():
     # kernel-measured variant: the winner is chosen on E2E time — a
     # variant whose advantage lives in the final select (narrower
     # candidate array) can never win a kernel-only ranking
+    def e2e_kw(key, final_select):
+        return dict(variant_kw(key), final_select=final_select)
+
     e2e = {}
     for key in measured:
-        timeit(lambda kw=dict(variants)[key]: local_certified_candidates(
-            qs, db, m=128, block_q=128, final_select="approx",
-            interpret=False, **kw), f"{key}_approx", e2e, key)
+        timeit(lambda kw=e2e_kw(key, "approx"): local_certified_candidates(
+            qs, db, m=128, interpret=False, **kw), f"{key}_approx", e2e, key)
     e2e_ok = [k for k in e2e if isinstance(e2e[k], float)]
     if not e2e_ok:
         with open(OUT, "a") as f:
@@ -299,11 +314,11 @@ def kernel_ab():
         log("  kernel A/B: ALL e2e probes failed; bench runs library defaults")
         return None
     best_kern = min(e2e_ok, key=lambda k: e2e[k])
-    best_kw = dict(variants)[best_kern]
+    best_kw = variant_kw(best_kern)
     # the winner's exact-final variant decides final_select
     timeit(lambda: local_certified_candidates(
-        qs, db, m=128, block_q=128, final_select="exact",
-        interpret=False, **best_kw), f"{best_kern}_exact", e2e,
+        qs, db, m=128, interpret=False,
+        **e2e_kw(best_kern, "exact")), f"{best_kern}_exact", e2e,
         f"{best_kern}_exact")
     fsel = ("exact"
             if isinstance(e2e.get(f"{best_kern}_exact"), float)
@@ -320,6 +335,7 @@ def kernel_ab():
     return {"KNN_BENCH_PALLAS_BINNING": best_kw["binning"],
             "KNN_BENCH_PALLAS_TILE": str(best_kw["tile_n"]),
             "KNN_BENCH_PALLAS_SURVIVORS": str(best_kw["survivors"]),
+            "KNN_BENCH_PALLAS_BLOCK_Q": str(best_kw["block_q"]),
             "KNN_BENCH_PALLAS_FINAL": fsel}
 
 
