@@ -656,7 +656,14 @@ def main() -> None:
         # which a 128-dim gate would never exercise — and the round-3
         # lesson is that soundness failures are build-detail dependent
         g_db = g_rng.random((100_000, DIM), dtype=np.float32) * 128
+        # tie pressure: duplicate rows + a near-tie pileup exercise the
+        # lexicographic rank correction and the near-tie mask in the
+        # compiled build (a different failure class than the round-3
+        # bounds-accumulation miss)
+        g_db[50_000:50_050] = g_db[:50]
+        g_db[70_000:70_020] = g_db[100] + 1e-3
         g_q = g_rng.random((24, DIM), dtype=np.float32) * 128
+        g_q[0] = g_db[100] + 5e-4  # lands inside the pileup
         g_k = min(K, 100)
         _, oracle = host_exact_knn(g_db, g_q, g_k)
         # gate the SAME kernel configuration the sweeps run (precision,

@@ -275,12 +275,13 @@ def kernel_ab():
         # can never measure different configurations
         kw = dict(dict(variants)[key])
         kw.setdefault("block_q", 128)
+        kw.setdefault("bin_w", 128)
         return kw
 
     for key, _ in variants:
         timeit(lambda kw=variant_kw(key): _bin_candidates(
-            qs, db, bin_w=128,
-            precision="bf16x3", interpret=False, **kw), key, kern, key)
+            qs, db, precision="bf16x3", interpret=False, **kw),
+            key, kern, key)
 
     measured = [k for k in kern if isinstance(kern[k], float)]
     if not measured:
@@ -336,6 +337,7 @@ def kernel_ab():
             "KNN_BENCH_PALLAS_TILE": str(best_kw["tile_n"]),
             "KNN_BENCH_PALLAS_SURVIVORS": str(best_kw["survivors"]),
             "KNN_BENCH_PALLAS_BLOCK_Q": str(best_kw["block_q"]),
+            "KNN_BENCH_PALLAS_BIN_W": str(best_kw["bin_w"]),
             "KNN_BENCH_PALLAS_FINAL": fsel}
 
 
