@@ -664,6 +664,11 @@ def main() -> None:
         g_db[70_000:70_020] = g_db[100] + 1e-3
         g_q = g_rng.random((24, DIM), dtype=np.float32) * 128
         g_q[0] = g_db[100] + 5e-4  # lands inside the pileup
+        # a query ON a duplicated pair forces EXACT ties across distant
+        # db tiles (rows 0 and 50_000 live ~3 tiles apart at the default
+        # geometry) into the top-k — the cross-tile lexicographic merge
+        # path a same-tile pileup alone never reaches
+        g_q[1] = g_db[0] + 5e-4
         g_k = min(K, 100)
         _, oracle = host_exact_knn(g_db, g_q, g_k)
         # gate the SAME kernel configuration the sweeps run (precision,

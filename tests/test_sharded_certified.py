@@ -270,3 +270,18 @@ def test_certified_l1_still_rejected(rng):
         prog.search_certified(rng.normal(size=(2, 8)).astype(np.float32))
 
 
+
+
+def test_certified_pallas_multitile_multichunk_sharded(rng):
+    # the gist-shaped corner: dim > DIM_CHUNK (multi-chunk scratch
+    # accumulation) x multiple db tiles per shard x 2 db shards, grouped
+    # binning — every structural axis of the kernel at once, vs the
+    # float64 oracle
+    db = rng.normal(size=(6 * 256 + 40, 200)).astype(np.float32) * 5
+    queries = rng.normal(size=(9, 200)).astype(np.float32) * 5
+    ref_d, ref_i = _oracle(db, queries, 6)
+    prog = ShardedKNN(db, mesh=make_mesh(2, 2), k=6)
+    d, i, stats = prog.search_certified(queries, selector="pallas",
+                                        tile_n=256, margin=8)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=5e-5)
