@@ -21,21 +21,35 @@ ONE database pass:
              (candidates) AND the (s+1)-th smallest value (the *exclusion
              bound*: no non-candidate in this bin can score below it)
 
-Outputs per (i, j) cell are exactly 128 lanes wide — survivors are
-concatenated across bins (``s * n_bins = 128``) — so every block satisfies
-the TPU's lane-alignment rule (the round-2 kernel's (256, 16) output block
-failed to lower for exactly this reason).  Each (query block, db tile)
-cell writes its per-bin exclusion bounds to its own disjoint output
-block; the min over tiles happens in XLA after the kernel.  (The bounds
-were originally min-accumulated in-place across tiles via output
-revisiting; the round-3 compiled-soundness gate recorded an inflated
-bound on hardware with that design, and per-tile emission costs ~0.3 ms
-of HBM writes while depending on no revisiting semantics at all.)
+Two bin LAYOUTS share this contract (``binning``, see ``BINNINGS``):
 
-Why top-2 per bin (the default): with 1M rows in 7813 bins, two true
-top-100 neighbors share a bin for ~47% of queries — a 1-survivor kernel
-falls back constantly (the round-2 failure mode).  Three sharing one bin
-happens ~0.3% of the time: top-2 makes the certified fast path the common
+- ``"grouped"`` (round-4 default): bin b = lane b of every 128-wide
+  column group of the score tile (128 bins/tile, members strided 128
+  apart).  The per-bin reduction runs across column groups as
+  elementwise vreg min/compare/select chains — ZERO cross-lane
+  shuffles; a single fused pass maintains the running (s+1)-smallest
+  per lane plus survivor group indices (``_emit_select_grouped``),
+  ~5x fewer VPU ops than the lane layout whose select dominated the
+  round-3 kernel (device MFU 2.25%).
+- ``"lane"`` (round-3): bins are contiguous 128-lane spans; min/argmin
+  reduce over lanes (~7 shuffle rounds each).  Kept for A/B.
+
+Outputs per (i, j) cell are lane-aligned blocks (``s * 128`` lanes in
+grouped mode; ``round_up(s * n_bins, 128)`` in lane mode — the round-2
+kernel's (256, 16) output block failed to lower for exactly this rule).
+Each (query block, db tile) cell writes its per-bin exclusion bounds to
+its own disjoint output block; the min over tiles happens in XLA after
+the kernel.  (The bounds were originally min-accumulated in-place across
+tiles via output revisiting; the round-3 compiled-soundness gate
+recorded an inflated bound on hardware with that design, and per-tile
+emission costs ~0.3 ms of HBM writes while depending on no revisiting
+semantics at all.)
+
+Why top-2 per bin (the default): with 1M rows in ~7900 128-member bins
+(either layout at the default geometry), two true top-100 neighbors
+share a bin for ~47% of queries — a 1-survivor kernel falls back
+constantly (the round-2 failure mode).  Three sharing one bin happens
+~0.3% of the time: top-2 makes the certified fast path the common
 case, and the bound makes every miss *detectable*:
 
   a point t outside the candidate set either (a) lost its bin's top-s —
