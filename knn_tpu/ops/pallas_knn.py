@@ -203,8 +203,11 @@ def effective_tile(
     candidate width to a large default tile (one 16384-tile over a 10k
     db emits 256 lanes where two 8192-tiles emitted 512) and raise the
     m+2-exceeds-width ValueError on margins that a smaller tile serves
-    fine.  ONE home for this arithmetic: local_certified_candidates and
-    parallel.sharded._pallas_setup must agree or their m-caps diverge."""
+    fine.  ONE home for this arithmetic: parallel.sharded._pallas_setup
+    resolves the tile here and plumbs the RESOLVED tile into the sharded
+    program, so local_certified_candidates' own call (min_width = m+2,
+    guaranteed covered by setup's m-cap) is a fixpoint — the two can
+    never run different tiles."""
     if tile_n % bin_w:
         # the caller's REQUESTED tile must be well-formed (the halving
         # below rounds its own internal steps, but never repairs an

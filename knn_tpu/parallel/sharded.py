@@ -203,6 +203,39 @@ def _knn_program(
 _RETRY_ATTEMPTS = 3
 _RETRY_WAIT_S = 0.5
 
+#: error-text signatures that identify a DETERMINISTIC failure — one a
+#: retry can only repeat (ADVICE r4: a Mosaic compile error or an OOM
+#: was retried 3x with ~3.5 s of backoff per batch of a long sweep
+#: before surfacing).  Matched case-insensitively against
+#: "TypeName: message".
+_DETERMINISTIC_SIGNATURES = (
+    "resource_exhausted", "resource exhausted", "out of memory",
+    "invalid_argument", "invalid argument", "failed_precondition",
+    "failed precondition", "unimplemented", "mosaic",
+)
+#: signatures of KNOWN-transient failures (relay flake vocabulary —
+#: r3/r4 session logs): these always get the full bounded-retry window,
+#: even when consecutive attempts fail identically.  Checked BEFORE the
+#: deterministic set: a flake whose text happens to also embed a
+#: deterministic token (e.g. "UNAVAILABLE: peer ran out of memory")
+#: must keep its retry window — erring toward retry costs seconds,
+#: erring toward fail-fast kills a recoverable sweep.
+_TRANSIENT_SIGNATURES = (
+    "unavailable", "deadline_exceeded", "deadline exceeded", "aborted",
+    "cancelled", "connection", "socket", "data_loss", "data loss",
+)
+
+
+def _classify_failure(e: Exception) -> str:
+    """'transient' (full retry window) | 'deterministic' (never retry) |
+    'unknown' (retry, but stop once the identical error repeats)."""
+    s = f"{type(e).__name__}: {e}".lower()
+    if any(sig in s for sig in _TRANSIENT_SIGNATURES):
+        return "transient"
+    if any(sig in s for sig in _DETERMINISTIC_SIGNATURES):
+        return "deterministic"
+    return "unknown"
+
 
 def _retry_wait(attempt: int) -> None:
     import time
@@ -210,17 +243,36 @@ def _retry_wait(attempt: int) -> None:
     time.sleep(_RETRY_WAIT_S * (2 ** attempt))
 
 
+def _should_give_up(cls: str, e: Exception,
+                    prev: Optional[Exception]) -> bool:
+    """True when retrying ``e`` (already classified as ``cls``) cannot
+    help: an unknown error whose repr exactly repeats the previous
+    attempt's is deterministic in effect, whatever its name."""
+    return (cls == "unknown" and prev is not None
+            and repr(e) == repr(prev))
+
+
 def _retry_transient(fn, what: str = "device call",
                      attempts: int = _RETRY_ATTEMPTS):
     """Call ``fn`` with bounded retries on transient (non-ValueError/
-    TypeError) failures — the dispatch-side half of the retry story."""
+    TypeError) failures — the dispatch-side half of the retry story.
+    Deterministic failures (compile errors, OOM — _classify_failure)
+    propagate immediately; an unrecognized error that repeats verbatim
+    stops retrying early."""
     err = None
     for attempt in range(attempts):
         try:
             return fn()
         except (ValueError, TypeError):
             raise  # caller bug: retry cannot help
-        except Exception as e:  # transient device/runtime failure
+        except Exception as e:
+            cls = _classify_failure(e)
+            if cls == "deterministic":
+                raise
+            if _should_give_up(cls, e, err):
+                raise RuntimeError(
+                    f"{what} failed after {attempt + 1} attempts "
+                    f"(identical error repeated)") from e
             err = e
             if attempt + 1 < attempts:
                 _retry_wait(attempt)
@@ -231,12 +283,15 @@ def _fetch_or_redispatch(out, redo, what: str = "device fetch",
                          attempts: int = _RETRY_ATTEMPTS):
     """``np.asarray(out)``, re-dispatching via ``redo()`` on transient
     failure — the fetch-side half: async device errors surface at the
-    host transfer, after the original dispatch call already returned."""
+    host transfer, after the original dispatch call already returned.
+    Same give-up policy as :func:`_retry_transient`."""
     try:
         return np.asarray(out)
     except (ValueError, TypeError):
         raise
     except Exception as e:
+        if _classify_failure(e) == "deterministic":
+            raise
         err = e
     for attempt in range(attempts - 1):
         _retry_wait(attempt)
@@ -245,6 +300,13 @@ def _fetch_or_redispatch(out, redo, what: str = "device fetch",
         except (ValueError, TypeError):
             raise
         except Exception as e:
+            cls = _classify_failure(e)
+            if cls == "deterministic":
+                raise
+            if _should_give_up(cls, e, err):
+                raise RuntimeError(
+                    f"{what} failed after {attempt + 2} attempts "
+                    f"(identical error repeated)") from e
             err = e
     raise RuntimeError(f"{what} failed after {attempts} attempts") from err
 
@@ -743,8 +805,14 @@ class ShardedKNN:
                 f"{shard_rows}-row shards; lower tile_n or use "
                 f"selector='approx'"
             )
+        # the program gets setup's RESOLVED tile, not the raw request:
+        # m was capped so that width(eff_tile) >= m+2, which makes the
+        # kernel's own effective_tile(min_width=m+2) a fixpoint — the
+        # tile the kernel runs is provably the tile this m-cap assumed
+        # (ADVICE r4: the raw-tile plumbing let the two diverge on small
+        # padded dbs where m is capped by n_train)
         prog = _pallas_certified_program(
-            self.mesh, m, self.k, self.merge, tile_n, precision,
+            self.mesh, m, self.k, self.merge, eff_tile, precision,
             n_train=self.n_train, bin_w=bin_w, survivors=survivors,
             block_q=block_q, final_select=final_select,
             include_distances=include_distances, binning=binning,

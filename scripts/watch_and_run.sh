@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Relay watcher (VERDICT r4 item 1a): poll the axon TPU relay and run the
+# round's TPU session the moment a claim window opens.  The r4 version of
+# this script lived only in a gitignored snapshot and died with the VM;
+# this one is committed and runs the repo tree it lives in.
+#
+# Usage:    nohup scripts/watch_and_run.sh > tpu_watch.log 2>&1 &
+# Env:      WATCH_INTERVAL   seconds between probes (default 300)
+#           WATCH_RERUN=1    keep re-running sessions after one succeeds
+#                            (default: stop probing once a session has
+#                            completed — bench lines are already banked
+#                            and a re-run would only re-spend the window)
+#           TPU_SESSION_*    forwarded to scripts/tpu_session.py
+#
+# Idempotency: a PID lockfile stops two watchers/sessions racing for the
+# claim (a second concurrent client can wedge the relay — r4 log); stale
+# locks from dead processes are reaped.  Each session appends to its own
+# timestamped log plus the shared tpu_bench_lines.jsonl, and the curated
+# artifact refresher (scripts/refresh_bench_artifacts.py) ranks every
+# line ever banked, so repeated windows re-enter safely.
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+LOCK="$REPO/.tpu_session.pid"
+DONE="$REPO/.tpu_session.done"
+INTERVAL="${WATCH_INTERVAL:-300}"
+
+log() { echo "[watch $(date -u +%H:%M:%S)] $*"; }
+
+holder_alive() {
+    [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK" 2>/dev/null)" 2>/dev/null
+}
+
+log "watcher up; repo=$REPO interval=${INTERVAL}s"
+while :; do
+    if holder_alive; then
+        log "session $(cat "$LOCK") still running; sleeping"
+        sleep "$INTERVAL"; continue
+    fi
+    rm -f "$LOCK"
+    if [ -f "$DONE" ] && [ "${WATCH_RERUN:-0}" != "1" ]; then
+        log "session already completed ($(cat "$DONE")); WATCH_RERUN=1 to re-arm"
+        exit 0
+    fi
+    # Cheap probe: a throwaway subprocess tries to init the backend.  A
+    # dead relay answers UNAVAILABLE only after ~25 min of grpc retries
+    # (r4 log), so the timeout bounds the probe, and the probe must EXIT
+    # before the session starts or its claim blocks the session's.
+    if timeout 180 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()[0].platform != "cpu"
+EOF
+    then
+        log "relay is UP; launching tpu_session.py"
+        stamp="$(date -u +%Y%m%dT%H%M%S)"
+        python scripts/tpu_session.py >> "tpu_session_watch_${stamp}.log" 2>&1 &
+        echo $! > "$LOCK"
+        wait "$(cat "$LOCK")"
+        rc=$?
+        rm -f "$LOCK"
+        if [ "$rc" -eq 0 ]; then
+            echo "$stamp rc=0" > "$DONE"
+            log "session completed rc=0 (log tpu_session_watch_${stamp}.log)"
+        else
+            log "session exited rc=$rc; will re-probe in ${INTERVAL}s"
+        fi
+    else
+        log "relay still down; sleeping ${INTERVAL}s"
+    fi
+    sleep "$INTERVAL"
+done

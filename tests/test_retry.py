@@ -136,6 +136,83 @@ def test_retry_gives_up_after_bounded_attempts(data, monkeypatch):
         prog.search(q)
 
 
+@pytest.fixture
+def no_backoff(monkeypatch):
+    # pure-unit policy tests need no real exponential sleeps
+    monkeypatch.setattr(sh, "_retry_wait", lambda attempt: None)
+
+
+def test_deterministic_failures_are_not_retried(no_backoff):
+    # ADVICE r4: a Mosaic compile error / OOM is deterministic — retrying
+    # it only adds ~3.5 s of backoff per batch before the real error
+    # surfaces.  The signature classifier must propagate it on attempt 1.
+    calls = {"n": 0}
+
+    def oom():
+        calls["n"] += 1
+        raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        sh._retry_transient(oom, "probe")
+    assert calls["n"] == 1
+
+    calls["n"] = 0
+
+    def mosaic():
+        calls["n"] += 1
+        raise RuntimeError("Mosaic failed to compile TPU kernel")
+
+    with pytest.raises(RuntimeError, match="Mosaic"):
+        sh._retry_transient(mosaic, "probe")
+    assert calls["n"] == 1
+
+
+def test_unknown_repeating_failure_gives_up_early(no_backoff):
+    # an unrecognized error that repeats VERBATIM is deterministic in
+    # effect: stop after the repeat (2 calls), not the full window (3)
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise RuntimeError("some novel permanent failure")
+
+    with pytest.raises(RuntimeError, match="failed after 2 attempts"):
+        sh._retry_transient(broken, "probe")
+    assert calls["n"] == 2
+
+
+def test_known_transient_gets_full_retry_window(no_backoff):
+    # relay-vocabulary errors (UNAVAILABLE etc.) keep the full bounded
+    # window even when attempts fail identically — that is the hiccup
+    # the backoff exists to outlast
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: connection reset by relay")
+        return "ok"
+
+    assert sh._retry_transient(flaky, "probe") == "ok"
+    assert calls["n"] == 3
+
+
+def test_fetch_deterministic_failure_not_redispatched(no_backoff):
+    state = {"redo": 0}
+
+    class OOMArray:
+        def __array__(self, dtype=None, copy=None):
+            raise RuntimeError("RESOURCE_EXHAUSTED: device OOM")
+
+    def redo():
+        state["redo"] += 1
+        return np.zeros(3)
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        sh._fetch_or_redispatch(OOMArray(), redo, "fetch")
+    assert state["redo"] == 0
+
+
 def test_caller_bugs_are_not_retried(data, monkeypatch):
     db, q = data
     real = sh._knn_program
