@@ -34,14 +34,21 @@ holder_alive() {
 
 log "watcher up; repo=$REPO interval=${INTERVAL}s"
 while :; do
-    if holder_alive; then
-        log "session $(cat "$LOCK") still running; sleeping"
-        sleep "$INTERVAL"; continue
-    fi
-    rm -f "$LOCK"
     if [ -f "$DONE" ] && [ "${WATCH_RERUN:-0}" != "1" ]; then
         log "session already completed ($(cat "$DONE")); WATCH_RERUN=1 to re-arm"
         exit 0
+    fi
+    # Atomic lock BEFORE the probe (noclobber write of our own PID): the
+    # probe itself takes the device claim, so two unlocked watchers
+    # probing concurrently is already the two-client wedge this lock
+    # exists to prevent.  The lock covers probe + session.
+    if ! (set -o noclobber; echo $$ > "$LOCK") 2>/dev/null; then
+        if holder_alive; then
+            log "watcher/session $(cat "$LOCK" 2>/dev/null) holds the lock; sleeping"
+            sleep "$INTERVAL"; continue
+        fi
+        rm -f "$LOCK"  # stale lock from a dead process; re-acquire next loop
+        continue
     fi
     # Cheap probe: a throwaway subprocess tries to init the backend.  A
     # dead relay answers UNAVAILABLE only after ~25 min of grpc retries
@@ -54,11 +61,8 @@ EOF
     then
         log "relay is UP; launching tpu_session.py"
         stamp="$(date -u +%Y%m%dT%H%M%S)"
-        python scripts/tpu_session.py >> "tpu_session_watch_${stamp}.log" 2>&1 &
-        echo $! > "$LOCK"
-        wait "$(cat "$LOCK")"
+        python scripts/tpu_session.py >> "tpu_session_watch_${stamp}.log" 2>&1
         rc=$?
-        rm -f "$LOCK"
         if [ "$rc" -eq 0 ]; then
             echo "$stamp rc=0" > "$DONE"
             log "session completed rc=0 (log tpu_session_watch_${stamp}.log)"
@@ -68,5 +72,6 @@ EOF
     else
         log "relay still down; sleeping ${INTERVAL}s"
     fi
+    rm -f "$LOCK"
     sleep "$INTERVAL"
 done
