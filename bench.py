@@ -649,6 +649,59 @@ def main() -> None:
                      "PCIe — device_qps is the harness-independent rate"),
         }
 
+    def phase_breakdown_counted(mode):
+        """Device-phase rate for the counted selectors (VERDICT r4 item
+        6: ``mfu_device`` for EVERY selector, not just the pallas
+        winner): the coarse select program alone for ``exact``, coarse +
+        count-below for ``certified_approx`` — no host refine, no result
+        transfer.  Measured at the SWEEP's batch shape (BATCH queries):
+        both timed sweeps dispatch BATCH-sized device programs, so this
+        is a compile-cache hit and the rate describes the geometry the
+        sweep actually ran — an NQ-shaped probe would silently pay a
+        fresh compile over the relay AND measure a different batch."""
+        import jax as _jax
+
+        from knn_tpu.parallel.sharded import (
+            DB_AXIS,
+            _count_program,
+            _knn_program,
+            _row_normalize_f64,
+        )
+
+        qb = queries[:BATCH]
+        if qb.shape[0] < BATCH:  # one compiled shape, like the sweeps
+            qb = np.pad(qb, ((0, BATCH - qb.shape[0]), (0, 0)))
+        shard_rows = prog._tp.shape[0] // prog.mesh.shape[DB_AXIS]
+        if mode == "exact":
+            coarse = _knn_program(
+                prog.mesh, coarse_k, METRIC, prog.merge, prog.n_train,
+                prog.train_tile, prog._dtype_key)
+            qp, _ = prog._place_queries(qb)
+            launches = [lambda: coarse(qp, prog._tp)]
+        else:
+            qn = _row_normalize_f64(qb) if METRIC == "cosine" else qb
+            cert_metric = "l2" if METRIC == "cosine" else METRIC
+            m_c = min(K + APPROX_MARGIN, prog.n_train, shard_rows)
+            coarse = _knn_program(
+                prog.mesh, m_c, cert_metric, prog.merge, prog.n_train,
+                prog.train_tile, prog._dtype_key, "approx",
+                recall_target=APPROX_RT)
+            count = _count_program(prog.mesh, prog.n_train, prog.train_tile)
+            qp, _ = prog._place_queries(qn)
+            # threshold values don't change the count pass's FLOPs
+            thr = np.zeros(qp.shape[0], np.float32)
+            launches = [lambda: coarse(qp, prog._tp),
+                        lambda: count(qp, prog._tp, thr)]
+        dev = 0.0
+        for launch in launches:
+            _jax.block_until_ready(launch())  # warm (a sweep cache hit)
+            t0 = time.perf_counter()
+            _jax.block_until_ready(launch())
+            dev += time.perf_counter() - t0
+        return {"device_s": round(dev, 4),
+                "device_batch": BATCH,
+                "device_qps": round(BATCH / dev, 1)}
+
     def soundness_gate():
         """Small-scale compiled certified search vs the float64 oracle —
         the same check scripts/tpu_session.py runs, embedded so a bare
@@ -756,6 +809,15 @@ def main() -> None:
             })
             if stats is not None:
                 entry["certified_stats"] = stats
+            if mode in ("exact", "certified_approx"):
+                pb = phase_breakdown_counted(mode)
+                entry["phase_breakdown"] = pb
+                if peak is not None and pb.get("device_s"):
+                    # the probe ran device_batch queries, not NQ
+                    bflops = (2.0 * pb["device_batch"] * N * DIM
+                              * passes[mode])
+                    entry["mfu_device"] = round(
+                        bflops / pb["device_s"] / peak, 4)
             if mode == "certified_pallas":
                 pb = phase_breakdown_pallas()
                 entry["phase_breakdown"] = pb

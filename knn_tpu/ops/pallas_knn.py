@@ -23,14 +23,19 @@ ONE database pass:
 
 Two bin LAYOUTS share this contract (``binning``, see ``BINNINGS``):
 
-- ``"grouped"`` (round-4 default): bin b = lane b of every 128-wide
+- ``"grouped"`` (default): bin b = lane b of every 128-wide
   column group of the score tile (128 bins/tile, members strided 128
   apart).  The per-bin reduction runs across column groups as
   elementwise vreg min/compare/select chains — ZERO cross-lane
   shuffles; a single fused pass maintains the running (s+1)-smallest
   per lane plus survivor group indices (``_emit_select_grouped``),
   ~5x fewer VPU ops than the lane layout whose select dominated the
-  round-3 kernel (device MFU 2.25%).
+  round-3 kernel (device MFU 2.25%).  Hardware-validated round 5
+  (ADVICE r4 conditioned the default on this): the compiled kernel
+  passed the 200k-row float64-oracle soundness gate AND bench.py's
+  embedded tie-stressed gate on a v5e chip, and measured 1.8-3.1x
+  faster than lane at the SIFT shape (kernel-only 171 -> 96/55.9 ms
+  per 4096 queries; tpu_bench_lines.jsonl kernel A/B).
 - ``"lane"`` (round-3): bins are contiguous 128-lane spans; min/argmin
   reduce over lanes (~7 shuffle rounds each).  Kept for A/B.
 

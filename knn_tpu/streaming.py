@@ -128,6 +128,12 @@ class StreamingSearch:
         return StreamState(n_queries, self.batch_size, n_batches, done)
 
     # -- execution ---------------------------------------------------------
+    #: pad each batch to ``batch_size`` and strip after (one compiled
+    #: shape; the reference aborts on non-divisible sizes instead,
+    #: knn_mpi.cpp:127-129).  Subclasses whose search fn pads internally
+    #: set False and receive the raw tail chunk.
+    _pad_batches = True
+
     def _run_batch(self, chunk: np.ndarray):
         # the per-batch retry delegates to the shared failure classifier
         # (parallel.sharded): known-transient errors get the full backoff
@@ -140,9 +146,21 @@ class StreamingSearch:
             attempts=self.max_retries + 1)
         return np.asarray(d), np.asarray(i)
 
-    def run(self, queries: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """Stream all batches, skipping finished ones; returns assembled
-        (dists [Q, k], idx [Q, k])."""
+    def _strip(self, result, pad: int):
+        """Drop the ``pad`` trailing padded rows from a batch result."""
+        d, i = result
+        return d[:-pad], i[:-pad]
+
+    def _payload(self, result) -> dict:
+        """Batch result -> the arrays persisted in its ``.npz``."""
+        d, i = result
+        return {"d": d, "i": i}
+
+    def run(self, queries: np.ndarray):
+        """Stream all batches, skipping finished ones; returns
+        :meth:`assemble` of the complete run.  ONE loop for every
+        subclass — padding, the atomic tmp+replace write, and the
+        done-set skip live here only."""
         queries = np.asarray(queries)
         n = queries.shape[0]
         self._check_manifest(queries)
@@ -154,29 +172,36 @@ class StreamingSearch:
             lo = b * self.batch_size
             chunk = queries[lo : lo + self.batch_size]
             pad = self.batch_size - chunk.shape[0]
-            if pad:  # keep one compiled shape (the reference aborts on
-                # non-divisible sizes instead, knn_mpi.cpp:127-129)
+            if pad and self._pad_batches:
                 chunk = np.pad(chunk, ((0, pad), (0, 0)))
-            d, i = self._run_batch(chunk)
-            if pad:
-                d, i = d[:-pad], i[:-pad]
+            result = self._run_batch(chunk)
+            if pad and self._pad_batches:
+                result = self._strip(result, pad)
             tmp = self._batch_path(b) + ".tmp"
             with open(tmp, "wb") as f:
-                np.savez(f, d=d, i=i)
+                np.savez(f, **self._payload(result))
             os.replace(tmp, self._batch_path(b))
         return self.assemble(n)
 
-    def assemble(self, n_queries: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Concatenate all finished batches (requires a complete run)."""
+    def _iter_complete(self, n_queries: int):
+        """Yield each finished batch's persisted arrays (dict), after
+        verifying the run is complete — the shared read side of
+        :meth:`assemble`."""
         st = self.state(n_queries)
         if not st.complete:
             missing = sorted(set(range(st.n_batches)) - set(st.done))
-            raise RuntimeError(f"stream incomplete; missing batches {missing[:8]}...")
-        ds, is_ = [], []
+            raise RuntimeError(
+                f"stream incomplete; missing batches {missing[:8]}...")
         for b in range(st.n_batches):
             with np.load(self._batch_path(b)) as z:
-                ds.append(z["d"])
-                is_.append(z["i"])
+                yield {key: z[key] for key in z.files}
+
+    def assemble(self, n_queries: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Concatenate all finished batches (requires a complete run)."""
+        ds, is_ = [], []
+        for z in self._iter_complete(n_queries):
+            ds.append(z["d"])
+            is_.append(z["i"])
         return np.concatenate(ds)[:n_queries], np.concatenate(is_)[:n_queries]
 
 
@@ -199,6 +224,10 @@ class StreamingCertifiedSearch(StreamingSearch):
     stats summed across segments.
     """
 
+    #: search_certified pads each segment internally to its own compiled
+    #: batch shape, so the streaming layer hands it the raw tail chunk
+    _pad_batches = False
+
     def _run_batch(self, chunk: np.ndarray):
         # same shared retry policy as StreamingSearch._run_batch — a
         # deterministic failure must not re-run a multi-thousand-query
@@ -214,46 +243,27 @@ class StreamingCertifiedSearch(StreamingSearch):
             dict(stats),
         )
 
-    def run(self, queries: np.ndarray):
-        queries = np.asarray(queries)
-        n = queries.shape[0]
-        self._check_manifest(queries)
-        st = self.state(n)
-        done = set(st.done)
-        for b in range(st.n_batches):
-            if b in done:
-                continue
-            lo = b * self.batch_size
-            d, i, stats = self._run_batch(queries[lo : lo + self.batch_size])
-            tmp = self._batch_path(b) + ".tmp"
-            with open(tmp, "wb") as f:
-                payload = {"i": i, "stats": json.dumps(stats)}
-                if d is not None:
-                    payload["d"] = d
-                np.savez(f, **payload)
-            os.replace(tmp, self._batch_path(b))
-        return self.assemble(n)
+    def _payload(self, result) -> dict:
+        d, i, stats = result
+        payload = {"i": i, "stats": json.dumps(stats)}
+        if d is not None:
+            payload["d"] = d
+        return payload
 
     def assemble(self, n_queries: int):
-        st = self.state(n_queries)
-        if not st.complete:
-            missing = sorted(set(range(st.n_batches)) - set(st.done))
-            raise RuntimeError(
-                f"stream incomplete; missing batches {missing[:8]}...")
         ds, is_, agg = [], [], {}
-        for b in range(st.n_batches):
-            with np.load(self._batch_path(b)) as z:
-                if "d" in z:
-                    ds.append(z["d"])
-                is_.append(z["i"])
-                stats = json.loads(str(z["stats"]))
-            for key, v in stats.items():
+        n_batches = 0
+        for z in self._iter_complete(n_queries):
+            n_batches += 1
+            if "d" in z:
+                ds.append(z["d"])
+            is_.append(z["i"])
+            for key, v in json.loads(str(z["stats"])).items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     agg[key] = agg.get(key, 0) + v
                 else:
                     agg[key] = v
-        d = (np.concatenate(ds)[:n_queries]
-             if len(ds) == st.n_batches and ds else None)
+        d = np.concatenate(ds)[:n_queries] if len(ds) == n_batches else None
         return d, np.concatenate(is_)[:n_queries], agg
 
 

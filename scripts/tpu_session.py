@@ -35,33 +35,61 @@ def log(msg):
 
 
 T0 = time.time()
-log("importing jax / acquiring device claim (may block a long time)...")
-import jax  # noqa: E402
-
-#: the relay intermittently answers UNAVAILABLE (or blocks) while a stale
-#: claim drains; retry forever — this process is the round's one shot at
-#: the chip and an early exit wastes the wait already paid
-devs = None
-attempt = 0
-while devs is None:
-    attempt += 1
-    try:
-        devs = jax.devices()
-    except RuntimeError as e:
-        log(f"attempt {attempt}: init failed ({str(e)[:120]}); retrying in 120s")
-        try:
-            jax.clear_caches()
-            from jax._src import xla_bridge
-
-            xla_bridge.backends.cache_clear()
-        except Exception:
-            pass
-        time.sleep(120)
-log(f"devices: {devs} backend={jax.default_backend()} "
-    f"kind={getattr(devs[0], 'device_kind', '?')}")
-
+import jax  # noqa: E402  (importing jax does NOT initialize a backend)
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
+
+
+def acquire_devices():
+    """Block until the relay grants the chip.  The relay intermittently
+    answers UNAVAILABLE (or blocks) while a stale claim drains; retry
+    forever — this process is the round's one shot at the chip and an
+    early exit wastes the wait already paid.  Shared with follow-up
+    session scripts (tpu_session_r5b.py) so the claim/retry policy has
+    ONE home."""
+    log("acquiring device claim (may block a long time)...")
+    devs = None
+    attempt = 0
+    while devs is None:
+        attempt += 1
+        try:
+            devs = jax.devices()
+        except RuntimeError as e:
+            log(f"attempt {attempt}: init failed ({str(e)[:120]}); "
+                f"retrying in 120s")
+            try:
+                jax.clear_caches()
+                from jax._src import xla_bridge
+
+                xla_bridge.backends.cache_clear()
+            except Exception:
+                pass
+            time.sleep(120)
+    log(f"devices: {devs} backend={jax.default_backend()} "
+        f"kind={getattr(devs[0], 'device_kind', '?')}")
+    return devs
+
+
+def start_heartbeat(period_s: float = 120.0):
+    """Daemon thread writing liveness to STDERR every ``period_s`` —
+    operator visibility ONLY (run_bench captures all of bench's stdout
+    until main() returns, so long benches look silent otherwise).  This
+    deliberately does NOT feed the watcher's stall detection: a wedged
+    client (main thread in the C-level connect-retry nanosleep) still
+    schedules daemon threads, so a heartbeat cannot distinguish wedge
+    from progress.  The watcher reads /proc CPU-time growth instead —
+    the one signal the r5 wedge measurably lacked (flat at zero delta
+    for 30+ min while healthy benches burn CPU continuously on
+    baselines, refines, and compiles)."""
+    import threading
+
+    def beat():
+        while True:
+            time.sleep(period_s)
+            print(f"[tpu_session +{time.time() - T0:.0f}s] heartbeat",
+                  file=sys.__stderr__, flush=True)
+
+    threading.Thread(target=beat, daemon=True).start()
 
 
 def pallas_proof():
@@ -357,6 +385,8 @@ def kernel_ab():
 
 def main():
     global GATE_OK
+    acquire_devices()
+    start_heartbeat()
     try:
         rec = pallas_proof()
         GATE_OK = bool(rec["pallas_proof"]["certified_exact"])

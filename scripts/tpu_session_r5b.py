@@ -30,34 +30,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 OUT = os.path.join(REPO, "tpu_bench_lines.jsonl")
 
-T0 = time.time()
+# ONE home for the claim/retry policy, the bench wrapper, and the
+# heartbeat: scripts/tpu_session.py (its module import has no side
+# effects; acquisition happens in the function call below)
+from scripts.tpu_session import (  # noqa: E402
+    acquire_devices,
+    log,
+    run_bench,
+    start_heartbeat,
+)
+import scripts.tpu_session as ts  # noqa: E402
 
-
-def log(msg):
-    print(f"[r5b +{time.time() - T0:.0f}s] {msg}", flush=True)
-
-
-log("importing jax / acquiring device claim ...")
 import jax  # noqa: E402
-
-devs = None
-attempt = 0
-while devs is None:
-    attempt += 1
-    try:
-        devs = jax.devices()
-    except RuntimeError as e:
-        log(f"attempt {attempt}: init failed ({str(e)[:120]}); retry in 120s")
-        try:
-            jax.clear_caches()
-            from jax._src import xla_bridge
-
-            xla_bridge.backends.cache_clear()
-        except Exception:
-            pass
-        time.sleep(120)
-log(f"devices: {devs} backend={jax.default_backend()}")
-
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
@@ -86,6 +70,8 @@ def timeit(launch, label, out, key, reps=3):
 
 
 def main():
+    acquire_devices()
+    start_heartbeat()
     rng = np.random.default_rng(0)
     db = jnp.asarray(rng.random((1_000_000, 128), dtype=np.float32) * 128)
     qs = jnp.asarray(rng.random((4096, 128), dtype=np.float32) * 128)
@@ -168,9 +154,6 @@ def main():
         log(f"no new winner (best {winner}={ok.get(winner)} ms); "
             f"skipping re-bench")
 
-    from scripts.tpu_session import run_bench  # reuse the bench wrapper
-    import scripts.tpu_session as ts
-
     ts.GATE_OK = None  # r5b runs no 200k proof; bench's own gate decides
     if overrides:
         try:
@@ -187,6 +170,18 @@ def main():
         run_bench("sift1m", env_overrides=probe_env)
     except Exception as e:
         log(f"batch-pipeline probe FAILED: {e!r}")
+
+    # glove + gist 5-run packed-fetch re-measurement (VERDICT r4 item 4):
+    # the r5a session's tunnel died during glove's placement, so these
+    # never ran under a green gate.  Their own tuned defaults, never the
+    # sift-shape A/B winner.
+    for cfg in os.environ.get("R5B_CONFIGS", "glove,gist1m").split(","):
+        if not cfg:
+            continue
+        try:
+            run_bench(cfg)
+        except Exception as e:
+            log(f"bench[{cfg}] FAILED: {e!r}")
     log("r5b done; exiting to release the claim")
 
 

@@ -10,7 +10,24 @@
 #                            (default: stop probing once a session has
 #                            completed — bench lines are already banked
 #                            and a re-run would only re-spend the window)
-#           TPU_SESSION_*    forwarded to scripts/tpu_session.py
+#           WATCH_SESSION    session script to run (default
+#                            scripts/tpu_session.py)
+#           WATCH_STALL_MIN  minutes of FLAT CPU TIME before a running
+#                            session is declared wedged and SIGKILLed
+#                            (default 20).  Round-5 lesson: when the
+#                            tunnel dies MID-session, the axon client
+#                            spins a C-level connect-retry nanosleep
+#                            that ignores SIGINT and never returns.
+#                            The discriminator is /proc CPU growth —
+#                            the r5 wedge sat at an exactly constant
+#                            CPU total for 30+ min, while a healthy
+#                            bench burns CPU continuously (baselines,
+#                            float64 refines, compiles); log mtime
+#                            would misfire, because bench stdout is
+#                            captured until each bench completes and
+#                            daemon heartbeats keep ticking even
+#                            through a wedge.
+#           TPU_SESSION_*    forwarded to the session script
 #
 # Idempotency: a PID lockfile stops two watchers/sessions racing for the
 # claim (a second concurrent client can wedge the relay — r4 log); stale
@@ -25,6 +42,8 @@ cd "$REPO"
 LOCK="$REPO/.tpu_session.pid"
 DONE="$REPO/.tpu_session.done"
 INTERVAL="${WATCH_INTERVAL:-300}"
+SESSION="${WATCH_SESSION:-scripts/tpu_session.py}"
+STALL_MIN="${WATCH_STALL_MIN:-20}"
 
 log() { echo "[watch $(date -u +%H:%M:%S)] $*"; }
 
@@ -50,24 +69,76 @@ while :; do
         rm -f "$LOCK"  # stale lock from a dead process; re-acquire next loop
         continue
     fi
-    # Cheap probe: a throwaway subprocess tries to init the backend.  A
-    # dead relay answers UNAVAILABLE only after ~25 min of grpc retries
-    # (r4 log), so the timeout bounds the probe, and the probe must EXIT
-    # before the session starts or its claim blocks the session's.
+    # Cheap probe, two stages.  Stage 1: are the relay's loopback ports
+    # even listening?  Refused ports mean no tunnel process exists — no
+    # point spinning the client's connect-retry loop (r4: ~25 min to
+    # UNAVAILABLE).  Stage 2: a throwaway subprocess tries a real init;
+    # the timeout bounds it, and the probe must EXIT before the session
+    # starts or its claim blocks the session's.
+    if python - <<'EOF' >/dev/null 2>&1
+import socket, sys
+for port in (8083, 8082):
+    s = socket.socket(); s.settimeout(2.0)
+    try:
+        s.connect(("127.0.0.1", port))
+    except ConnectionRefusedError:
+        continue
+    except OSError:
+        sys.exit(0)  # filtered/timeout: can't conclude absence, probe on
+    else:
+        sys.exit(0)  # something listens: relay may be alive
+    finally:
+        s.close()
+sys.exit(1)  # every port refused: no tunnel
+EOF
+    then :; else
+        log "relay ports refused (no tunnel); sleeping ${INTERVAL}s"
+        rm -f "$LOCK"; sleep "$INTERVAL"; continue
+    fi
     if timeout 180 python - <<'EOF' >/dev/null 2>&1
 import jax
 assert jax.devices()[0].platform != "cpu"
 EOF
     then
-        log "relay is UP; launching tpu_session.py"
+        log "relay is UP; launching $SESSION"
         stamp="$(date -u +%Y%m%dT%H%M%S)"
-        python scripts/tpu_session.py >> "tpu_session_watch_${stamp}.log" 2>&1
+        slog="tpu_session_watch_${stamp}.log"
+        python "$SESSION" >> "$slog" 2>&1 &
+        spid=$!
+        # hand the lock to the session: if THIS watcher dies, a later
+        # watcher must see the live session's PID, not a dead watcher's
+        echo "$spid" > "$LOCK"
+        # Stall watchdog on CPU-TIME GROWTH: a session whose total CPU
+        # (utime+stime, /proc/PID/stat fields 14+15) stays flat for
+        # STALL_MIN minutes is wedged in the client's uninterruptible
+        # connect-retry (tunnel died mid-session) — SIGKILL it and go
+        # back to probing.  Threshold 500 ticks (~5 s of CPU): genuine
+        # progress always clears it, thread scheduling noise never does.
+        killed=0
+        last_cpu=0
+        flat_since=$(date +%s)
+        while kill -0 "$spid" 2>/dev/null; do
+            sleep 60
+            now=$(date +%s)
+            cpu=$(awk '{print $14+$15}' "/proc/$spid/stat" 2>/dev/null || echo "")
+            [ -z "$cpu" ] && break  # session exited between checks
+            if [ $(( cpu - last_cpu )) -ge 500 ]; then
+                flat_since=$now
+            fi
+            last_cpu=$cpu
+            if [ $(( now - flat_since )) -ge $(( STALL_MIN * 60 )) ]; then
+                log "session $spid CPU flat ${STALL_MIN}m; SIGKILL (wedged client)"
+                kill -9 "$spid" 2>/dev/null
+                killed=1
+            fi
+        done
+        wait "$spid"
         rc=$?
-        if [ "$rc" -eq 0 ]; then
+        if [ "$killed" -eq 0 ] && [ "$rc" -eq 0 ]; then
             echo "$stamp rc=0" > "$DONE"
-            log "session completed rc=0 (log tpu_session_watch_${stamp}.log)"
+            log "session completed rc=0 (log $slog)"
         else
-            log "session exited rc=$rc; will re-probe in ${INTERVAL}s"
+            log "session ended rc=$rc killed=$killed; re-probing in ${INTERVAL}s"
         fi
     else
         log "relay still down; sleeping ${INTERVAL}s"
