@@ -85,6 +85,29 @@ def main() -> int:
          (qs, db), dict(m=128, block_q=128, tile_n=16384,
                         final_select="exact", interpret=False,
                         binning="grouped")),
+        # the r5b follow-up grid (scripts/tpu_session_r5b.py): the
+        # t32768 x bq256 cross the r5a A/B never measured (32 MB score
+        # tile — the largest VMEM geometry yet) and the bf16x3f fused
+        # contraction, never timed on hardware (VERDICT r4 item 6)
+        ("kernel grouped t32768 bq256", _bin_candidates, (qs, db),
+         dict(block_q=256, tile_n=32768, bin_w=128, survivors=2,
+              precision="bf16x3", interpret=False, binning="grouped")),
+        ("kernel grouped t32768 bq256 s3", _bin_candidates, (qs, db),
+         dict(block_q=256, tile_n=32768, bin_w=128, survivors=3,
+              precision="bf16x3", interpret=False, binning="grouped")),
+        ("kernel grouped t32768 x3f", _bin_candidates, (qs, db),
+         dict(block_q=128, tile_n=32768, bin_w=128, survivors=2,
+              precision="bf16x3f", interpret=False, binning="grouped")),
+        ("kernel grouped t16384 bq256 x3f", _bin_candidates, (qs, db),
+         dict(block_q=256, tile_n=16384, bin_w=128, survivors=2,
+              precision="bf16x3f", interpret=False, binning="grouped")),
+        ("kernel grouped t32768 bq256 x3f", _bin_candidates, (qs, db),
+         dict(block_q=256, tile_n=32768, bin_w=128, survivors=2,
+              precision="bf16x3f", interpret=False, binning="grouped")),
+        ("certified grouped t32768 bq256 exact", local_certified_candidates,
+         (qs, db), dict(m=128, block_q=256, tile_n=32768,
+                        final_select="exact", interpret=False,
+                        binning="grouped")),
         # non-128-dim configs: multi-chunk scratch accumulation, at the
         # library-default tile (what a bench run with no overrides uses)
         ("kernel grouped gist dim960 t16384", _bin_candidates, (qg, dbg),

@@ -67,6 +67,16 @@ def acquire_devices():
             time.sleep(120)
     log(f"devices: {devs} backend={jax.default_backend()} "
         f"kind={getattr(devs[0], 'device_kind', '?')}")
+    marker = os.environ.get("WATCH_ACQUIRED_FILE")
+    if marker:
+        # tell the watcher the claim is GRANTED: its flat-CPU stall
+        # watchdog must not count the acquisition wait (this loop sleeps
+        # at ~zero CPU by design — indistinguishable from the wedge)
+        try:
+            with open(marker, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
     return devs
 
 

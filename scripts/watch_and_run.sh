@@ -27,6 +27,11 @@
 #                            captured until each bench completes and
 #                            daemon heartbeats keep ticking even
 #                            through a wedge.
+#           WATCH_POLL_S     watchdog poll period in seconds (default
+#                            60; tests shrink it)
+#           WATCH_PROBE_CMD  override the relay probe (a command whose
+#                            exit status is the probe verdict); tests
+#                            inject `true`/`false`
 #           TPU_SESSION_*    forwarded to the session script
 #
 # Idempotency: a PID lockfile stops two watchers/sessions racing for the
@@ -39,11 +44,33 @@
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$REPO"
-LOCK="$REPO/.tpu_session.pid"
-DONE="$REPO/.tpu_session.done"
+# WATCH_STATE_DIR isolates the lock/done sentinels (tests point it at a
+# tmpdir so a test watcher can never disarm or dead-lock a real one)
+STATE_DIR="${WATCH_STATE_DIR:-$REPO}"
+LOCK="$STATE_DIR/.tpu_session.pid"
+DONE="$STATE_DIR/.tpu_session.done"
 INTERVAL="${WATCH_INTERVAL:-300}"
 SESSION="${WATCH_SESSION:-scripts/tpu_session.py}"
 STALL_MIN="${WATCH_STALL_MIN:-20}"
+STALL_S="${WATCH_STALL_S:-$(( STALL_MIN * 60 ))}"
+POLL_S="${WATCH_POLL_S:-60}"
+#: CPU-tick delta per poll window that counts as progress.  The r5 wedge
+#: measured EXACTLY zero delta over 27 min (the connect-retry nanosleep
+#: burns none), so 50 ticks (~0.5 s CPU) clears scheduling noise while
+#: staying far below any healthy activity; raise only with evidence.
+CPU_TICKS="${WATCH_CPU_TICKS:-50}"
+#: the session's device-claim ACQUISITION wait sleeps at ~zero CPU by
+#: design (tpu_session.acquire_devices retries forever) — the watchdog
+#: must not read it as a wedge.  The session touches WATCH_ACQUIRED_FILE
+#: once the claim is granted; until then only this (much longer) budget
+#: applies.
+ACQUIRE_MAX_S="${WATCH_ACQUIRE_MAX_S:-7200}"
+
+release_lock() {
+    # compare-and-delete: only the PID we wrote may be removed — a
+    # stale-reaping peer watcher may have re-acquired the lock already
+    [ "$(cat "$LOCK" 2>/dev/null)" = "$1" ] && rm -f "$LOCK"
+}
 
 log() { echo "[watch $(date -u +%H:%M:%S)] $*"; }
 
@@ -69,13 +96,19 @@ while :; do
         rm -f "$LOCK"  # stale lock from a dead process; re-acquire next loop
         continue
     fi
-    # Cheap probe, two stages.  Stage 1: are the relay's loopback ports
-    # even listening?  Refused ports mean no tunnel process exists — no
-    # point spinning the client's connect-retry loop (r4: ~25 min to
-    # UNAVAILABLE).  Stage 2: a throwaway subprocess tries a real init;
-    # the timeout bounds it, and the probe must EXIT before the session
-    # starts or its claim blocks the session's.
-    if python - <<'EOF' >/dev/null 2>&1
+    # Cheap probe, two stages (WATCH_PROBE_CMD replaces both in tests).
+    # Stage 1: are the relay's loopback ports even listening?  Refused
+    # ports mean no tunnel process exists — no point spinning the
+    # client's connect-retry loop (r4: ~25 min to UNAVAILABLE).
+    # Stage 2: a throwaway subprocess tries a real init; the timeout
+    # bounds it, and the probe must EXIT before the session starts or
+    # its claim blocks the session's.
+    probe_relay() {
+        if [ -n "${WATCH_PROBE_CMD:-}" ]; then
+            eval "$WATCH_PROBE_CMD"
+            return $?
+        fi
+        python - <<'EOF' >/dev/null 2>&1 || return 2
 import socket, sys
 for port in (8083, 8082):
     s = socket.socket(); s.settimeout(2.0)
@@ -91,58 +124,80 @@ for port in (8083, 8082):
         s.close()
 sys.exit(1)  # every port refused: no tunnel
 EOF
-    then :; else
-        log "relay ports refused (no tunnel); sleeping ${INTERVAL}s"
-        rm -f "$LOCK"; sleep "$INTERVAL"; continue
-    fi
-    if timeout 180 python - <<'EOF' >/dev/null 2>&1
+        timeout 180 python - <<'EOF' >/dev/null 2>&1
 import jax
 assert jax.devices()[0].platform != "cpu"
 EOF
+    }
+    probe_relay
+    prc=$?
+    if [ "$prc" -eq 2 ]; then
+        log "relay ports refused (no tunnel); sleeping ${INTERVAL}s"
+        release_lock $$; sleep "$INTERVAL"; continue
+    fi
+    if [ "$prc" -eq 0 ]
     then
         log "relay is UP; launching $SESSION"
         stamp="$(date -u +%Y%m%dT%H%M%S)"
-        slog="tpu_session_watch_${stamp}.log"
-        python "$SESSION" >> "$slog" 2>&1 &
+        slog="$STATE_DIR/tpu_session_watch_${stamp}.log"
+        acq="$STATE_DIR/.tpu_session.acquired_${stamp}"
+        rm -f "$acq"
+        WATCH_ACQUIRED_FILE="$acq" python "$SESSION" >> "$slog" 2>&1 &
         spid=$!
         # hand the lock to the session: if THIS watcher dies, a later
         # watcher must see the live session's PID, not a dead watcher's
         echo "$spid" > "$LOCK"
         # Stall watchdog on CPU-TIME GROWTH: a session whose total CPU
         # (utime+stime, /proc/PID/stat fields 14+15) stays flat for
-        # STALL_MIN minutes is wedged in the client's uninterruptible
+        # STALL_S seconds is wedged in the client's uninterruptible
         # connect-retry (tunnel died mid-session) — SIGKILL it and go
-        # back to probing.  Threshold 500 ticks (~5 s of CPU): genuine
-        # progress always clears it, thread scheduling noise never does.
+        # back to probing.
         killed=0
         last_cpu=0
-        flat_since=$(date +%s)
+        launch_ts=$(date +%s)
+        flat_since=$launch_ts
         while kill -0 "$spid" 2>/dev/null; do
-            sleep 60
+            sleep "$POLL_S"
             now=$(date +%s)
             cpu=$(awk '{print $14+$15}' "/proc/$spid/stat" 2>/dev/null || echo "")
             [ -z "$cpu" ] && break  # session exited between checks
-            if [ $(( cpu - last_cpu )) -ge 500 ]; then
+            if [ ! -f "$acq" ]; then
+                # still ACQUIRING the claim: its retry loop legitimately
+                # sleeps at zero CPU — only the acquisition budget applies
+                flat_since=$now
+                if [ $(( now - launch_ts )) -ge "$ACQUIRE_MAX_S" ]; then
+                    log "session $spid no claim after ${ACQUIRE_MAX_S}s; SIGKILL"
+                    kill -9 "$spid" 2>/dev/null
+                    killed=1
+                fi
+                last_cpu=$cpu
+                continue
+            fi
+            if [ $(( cpu - last_cpu )) -ge "$CPU_TICKS" ]; then
                 flat_since=$now
             fi
             last_cpu=$cpu
-            if [ $(( now - flat_since )) -ge $(( STALL_MIN * 60 )) ]; then
-                log "session $spid CPU flat ${STALL_MIN}m; SIGKILL (wedged client)"
+            if [ $(( now - flat_since )) -ge "$STALL_S" ]; then
+                log "session $spid CPU flat ${STALL_S}s; SIGKILL (wedged client)"
                 kill -9 "$spid" 2>/dev/null
                 killed=1
             fi
         done
         wait "$spid"
         rc=$?
+        rm -f "$acq"
         if [ "$killed" -eq 0 ] && [ "$rc" -eq 0 ]; then
             echo "$stamp rc=0" > "$DONE"
             log "session completed rc=0 (log $slog)"
         else
             log "session ended rc=$rc killed=$killed; re-probing in ${INTERVAL}s"
         fi
+        release_lock "$spid"
+        sleep "$INTERVAL"
+        continue
     else
         log "relay still down; sleeping ${INTERVAL}s"
     fi
-    rm -f "$LOCK"
+    release_lock $$
     sleep "$INTERVAL"
 done

@@ -1,0 +1,130 @@
+"""End-to-end tests of the relay watcher's stall watchdog
+(scripts/watch_and_run.sh) — the round-5 operational lesson: a tunnel
+death MID-session leaves the axon client in an uninterruptible C-level
+connect-retry nanosleep at exactly zero CPU delta, and the watcher must
+SIGKILL it and go back to probing, while never killing a healthy session
+that merely looks silent (bench stdout is captured until completion).
+
+The watcher's probe, poll period, stall window, and CPU threshold are
+env-injectable, so these tests run in seconds with a `true` probe and
+fake sessions: a pure-sleep python (the wedge signature) and a busy-loop
+python (healthy progress).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCHER = os.path.join(REPO, "scripts", "watch_and_run.sh")
+
+
+def _run_watcher(tmp_path, session_code, *, stall_s, extra_env=None,
+                 wait_s=45, want_in_log=()):
+    """Launch the watcher against an inline fake session; return its log.
+
+    The watcher cd's to its repo, so the fake lock/done artifacts are
+    isolated by pointing the session and log into tmp_path and cleaning
+    the repo-level lockfiles afterward.
+    """
+    session = tmp_path / "fake_session.py"
+    session.write_text(session_code)
+    env = dict(os.environ)
+    env.update({
+        "WATCH_PROBE_CMD": "true",
+        "WATCH_SESSION": str(session),
+        "WATCH_STALL_S": str(stall_s),
+        "WATCH_POLL_S": "2",
+        "WATCH_INTERVAL": "2",
+        # fully isolated lock/done sentinels: a test watcher must never
+        # disarm (write DONE) or block a genuinely armed repo watcher
+        "WATCH_STATE_DIR": str(tmp_path),
+        **(extra_env or {}),
+    })
+    log = tmp_path / "watch.log"
+    with open(log, "w") as lf:
+        p = subprocess.Popen(["bash", WATCHER], env=env, stdout=lf,
+                             stderr=subprocess.STDOUT, cwd=REPO)
+    try:
+        deadline = time.time() + wait_s
+        while time.time() < deadline:
+            text = log.read_text()
+            if all(s in text for s in want_in_log):
+                break
+            if p.poll() is not None and all(
+                    s in text for s in want_in_log):
+                break
+            time.sleep(1.0)
+    finally:
+        p.send_signal(signal.SIGTERM)
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+        # reap any fake session the watcher left behind
+        subprocess.run(["pkill", "-f", "fake_session.py"], check=False)
+    return log.read_text()
+
+
+#: fake sessions mark the claim as acquired first (the watchdog's
+#: flat-CPU accounting only arms after WATCH_ACQUIRED_FILE appears —
+#: the real acquisition wait sleeps at zero CPU by design)
+_MARK_ACQUIRED = (
+    "import os, time\n"
+    "open(os.environ['WATCH_ACQUIRED_FILE'], 'w').write('x')\n"
+)
+
+
+@pytest.mark.slow
+def test_watchdog_kills_wedged_session(tmp_path):
+    # wedge signature: a post-acquisition session sleeping at zero CPU
+    # delta (the axon client's connect-retry nanosleep) must be
+    # SIGKILLed after STALL_S
+    text = _run_watcher(
+        tmp_path,
+        _MARK_ACQUIRED + "time.sleep(600)\n",
+        stall_s=6,
+        want_in_log=("SIGKILL (wedged client)", "killed=1"),
+    )
+    assert "SIGKILL (wedged client)" in text, text
+    assert "killed=1" in text, text
+
+
+@pytest.mark.slow
+def test_watchdog_spares_busy_session_and_records_done(tmp_path):
+    # healthy signature: continuous CPU burn resets the flat-window on
+    # every poll; the session must complete (rc=0) and write the DONE
+    # sentinel, after which the watcher exits instead of re-probing
+    text = _run_watcher(
+        tmp_path,
+        _MARK_ACQUIRED + (
+            "t0 = time.time()\n"
+            "while time.time() - t0 < 12:\n"
+            "    sum(i * i for i in range(100000))\n"
+        ),
+        stall_s=6,
+        wait_s=60,
+        want_in_log=("session completed rc=0",),
+    )
+    assert "SIGKILL" not in text, text
+    assert "session completed rc=0" in text, text
+
+
+@pytest.mark.slow
+def test_watchdog_spares_acquisition_wait_until_budget(tmp_path):
+    # a session that never acquires the claim sleeps at zero CPU
+    # LEGITIMATELY — the stall window must not fire; only the (longer)
+    # acquisition budget may kill it
+    text = _run_watcher(
+        tmp_path,
+        "import time\ntime.sleep(600)\n",  # never touches the marker
+        stall_s=4,
+        extra_env={"WATCH_ACQUIRE_MAX_S": "12"},
+        want_in_log=("no claim after 12s; SIGKILL",),
+    )
+    assert "wedged client" not in text, text
+    assert "no claim after 12s; SIGKILL" in text, text
