@@ -75,8 +75,11 @@ def acquire_devices():
         try:
             with open(marker, "w") as f:
                 f.write(str(os.getpid()))
-        except OSError:
-            pass
+        except OSError as e:
+            # an unwritable marker silently DISARMS the watcher's fast
+            # stall watchdog (it would stay on the slow acquisition
+            # budget) — say so where the operator will look
+            log(f"WARNING: could not write claim marker {marker}: {e}")
     return devs
 
 

@@ -74,10 +74,6 @@ release_lock() {
 
 log() { echo "[watch $(date -u +%H:%M:%S)] $*"; }
 
-holder_alive() {
-    [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK" 2>/dev/null)" 2>/dev/null
-}
-
 log "watcher up; repo=$REPO interval=${INTERVAL}s"
 while :; do
     if [ -f "$DONE" ] && [ "${WATCH_RERUN:-0}" != "1" ]; then
@@ -89,11 +85,16 @@ while :; do
     # probing concurrently is already the two-client wedge this lock
     # exists to prevent.  The lock covers probe + session.
     if ! (set -o noclobber; echo $$ > "$LOCK") 2>/dev/null; then
-        if holder_alive; then
-            log "watcher/session $(cat "$LOCK" 2>/dev/null) holds the lock; sleeping"
+        observed="$(cat "$LOCK" 2>/dev/null)"
+        if [ -n "$observed" ] && kill -0 "$observed" 2>/dev/null; then
+            log "watcher/session $observed holds the lock; sleeping"
             sleep "$INTERVAL"; continue
         fi
-        rm -f "$LOCK"  # stale lock from a dead process; re-acquire next loop
+        # stale lock: compare-and-delete the exact PID we observed dead —
+        # a peer may have already reaped it and re-acquired with a LIVE
+        # PID, which a blind rm would destroy (two concurrent probes =
+        # the relay wedge)
+        [ "$(cat "$LOCK" 2>/dev/null)" = "$observed" ] && rm -f "$LOCK"
         continue
     fi
     # Cheap probe, two stages (WATCH_PROBE_CMD replaces both in tests).

@@ -190,6 +190,54 @@ def test_multihost_real_processes_bitwise_parity(rng, tmp_path):
         np.asarray(results[0]["d"], dtype=np.float32), np.asarray(ref_d))
 
 
+def test_multihost_certified_pallas_bitwise_parity(rng, tmp_path):
+    """The FLAGSHIP path under REAL multi-host: 2 jax.distributed CPU
+    processes, the db constructed from the full host array on each host
+    (the reference's replicated-host-data pattern, knn_mpi.cpp:224 —
+    required because the certified pipeline's float64 refine needs a
+    host copy), ``search_certified`` with the one-pass pallas selector
+    sharding the db axis across the process boundary.  Both processes
+    must agree bitwise and match the single-process run — indices,
+    float64 distances, AND certification stats."""
+    results = _spawn_jax_procs(tmp_path, """
+        import sys, json
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        pid, n_proc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+        from knn_tpu.parallel import multihost
+        from knn_tpu.parallel.sharded import ShardedKNN
+
+        multihost.initialize(coordinator_address=f"localhost:{port}",
+                             num_processes=n_proc, process_id=pid)
+        rng = np.random.default_rng(0)
+        db = (rng.random((96, 8)) * 10).astype(np.float32)
+        q = (rng.random((6, 8)) * 10).astype(np.float32)
+        mesh = multihost.global_mesh(1, n_proc)
+        prog = ShardedKNN(db, mesh=mesh, k=5)
+        d, i, stats = prog.search_certified(q, selector="pallas", margin=8)
+        print("RESULT " + json.dumps({
+            "pid": pid, "i": np.asarray(i).tolist(),
+            "d": np.asarray(d).tolist(), "stats": stats}), flush=True)
+    """, n_proc=2)
+
+    assert results[0]["i"] == results[1]["i"]
+    assert results[0]["d"] == results[1]["d"]
+    assert results[0]["stats"] == results[1]["stats"]
+
+    data_rng = np.random.default_rng(0)
+    db = (data_rng.random((96, 8)) * 10).astype(np.float32)
+    q = (data_rng.random((6, 8)) * 10).astype(np.float32)
+    ref_d, ref_i, ref_stats = ShardedKNN(
+        db, mesh=make_mesh(1, 2), k=5).search_certified(
+            q, selector="pallas", margin=8)
+    np.testing.assert_array_equal(np.asarray(results[0]["i"]), ref_i)
+    np.testing.assert_array_equal(
+        np.asarray(results[0]["d"], dtype=np.float64), ref_d)
+    assert results[0]["stats"] == ref_stats
+
+
 def test_multihost_2x2_mesh_four_processes(rng, tmp_path):
     """4 jax.distributed CPU processes on a (2, 2) mesh: BOTH the query
     and db axes span process boundaries, and each process assembles its
