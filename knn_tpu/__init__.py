@@ -60,6 +60,8 @@ _EXPORTS = {
     "pallas_knn_candidates": "knn_tpu.ops.pallas_knn",
     "StreamingSearch": "knn_tpu.streaming",
     "streaming_knn": "knn_tpu.streaming",
+    "StreamingCertifiedSearch": "knn_tpu.streaming",
+    "streaming_certified_knn": "knn_tpu.streaming",
 }
 
 __all__ = sorted(_EXPORTS) + ["__version__"]

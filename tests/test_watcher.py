@@ -14,7 +14,6 @@ python (healthy progress).
 import os
 import signal
 import subprocess
-import sys
 import time
 
 import pytest
