@@ -48,6 +48,9 @@ _EXPORTS = {
     "KNNClassifier": "knn_tpu.models.classifier",
     "knn_predict": "knn_tpu.models.classifier",
     "KNNRegressor": "knn_tpu.models.regressor",
+    "RadiusNeighborsClassifier": "knn_tpu.models.radius",
+    "radius_search": "knn_tpu.ops.radius",
+    "count_within": "knn_tpu.ops.radius",
     "JobConfig": "knn_tpu.utils.config",
     "run_job": "knn_tpu.pipeline",
     "JobResult": "knn_tpu.pipeline",

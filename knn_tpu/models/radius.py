@@ -1,0 +1,137 @@
+"""Radius-neighbors classifier — fixed-radius voting on top of
+ops.radius (beyond the reference's fixed-K vote, same vote semantics).
+
+The vote among in-radius neighbors reuses the reference's exact
+first-to-reach-max tie-break (ops.vote, knn_mpi.cpp:324-336): in-radius
+neighbors form the ascending-distance prefix of the bounded result, and
+masked slots carry label -1, which ``jax.nn.one_hot`` drops from the
+histogram — so the running-argmax semantics carry over unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from knn_tpu.ops.normalize import minmax_apply, minmax_stats
+from knn_tpu.ops.radius import SENTINEL_IDX, radius_search
+from knn_tpu.ops.vote import majority_vote
+
+
+class RadiusNeighborsClassifier:
+    """Classify by majority vote among all training points within
+    ``radius`` of the query (nearest ``max_neighbors`` of them when more
+    are inside — see ``strict``).
+
+    Args:
+      radius: metric-units radius (Euclidean for l2 — see
+        ops.radius.radius_threshold).
+      max_neighbors: bounded result width M (TPU needs static shapes).
+        ``strict=True`` (default) raises when any query has more than M
+        in-radius neighbors, so the vote is never silently truncated;
+        ``strict=False`` votes among the nearest M — a documented
+        approximation, with the exact counts still available via
+        :meth:`radius_neighbors`.
+      outlier_label: label for queries with ZERO in-radius neighbors;
+        None (default) raises on the first outlier instead.
+      metric / normalize / train_tile / compute_dtype: as KNNClassifier.
+    """
+
+    def __init__(
+        self,
+        radius: float,
+        *,
+        max_neighbors: int = 128,
+        metric: str = "l2",
+        num_classes: Optional[int] = None,
+        normalize: bool = False,
+        train_tile: Optional[int] = None,
+        compute_dtype=None,
+        outlier_label: Optional[int] = None,
+        strict: bool = True,
+    ):
+        from knn_tpu.ops.radius import radius_threshold
+
+        radius_threshold(radius, metric)  # validate radius/metric pairing now
+        self.radius = radius
+        self.max_neighbors = max_neighbors
+        self.metric = metric
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.train_tile = train_tile
+        self.compute_dtype = compute_dtype
+        self.outlier_label = outlier_label
+        self.strict = strict
+        self._train = None
+        self._labels = None
+        self._mins = None
+        self._maxs = None
+
+    def fit(self, X, y) -> "RadiusNeighborsClassifier":
+        X = jnp.asarray(X)
+        y = jnp.asarray(y, dtype=jnp.int32)
+        if X.ndim != 2 or y.ndim != 1 or X.shape[0] != y.shape[0]:
+            raise ValueError(f"bad shapes: X {X.shape}, y {y.shape}")
+        if self.num_classes is None:
+            self.num_classes = int(jnp.max(y)) + 1
+        if self.normalize:
+            self._mins, self._maxs = minmax_stats([X])
+            X = minmax_apply(X, self._mins, self._maxs)
+        self._train = X
+        self._labels = y
+        return self
+
+    def _require_fit(self):
+        if self._train is None:
+            raise RuntimeError("call fit() before predict()/radius_neighbors()")
+
+    def _prep_queries(self, Q):
+        Q = jnp.asarray(Q)
+        if Q.ndim != 2 or Q.shape[1] != self._train.shape[1]:
+            raise ValueError(f"queries {Q.shape} vs train {self._train.shape}")
+        if self.normalize:
+            Q = minmax_apply(Q, self._mins, self._maxs)
+        return Q
+
+    def radius_neighbors(self, Q):
+        """(dists [Q, M], idx [Q, M], counts [Q]) — see ops.radius."""
+        self._require_fit()
+        return radius_search(
+            self._prep_queries(Q), self._train, self.radius,
+            max_neighbors=self.max_neighbors, metric=self.metric,
+            train_tile=self.train_tile, compute_dtype=self.compute_dtype,
+        )
+
+    def predict(self, Q):
+        self._require_fit()
+        _, idx, counts = self.radius_neighbors(Q)
+        counts = np.asarray(counts)
+        if self.strict and (counts > self.max_neighbors).any():
+            worst = int(counts.max())
+            raise ValueError(
+                f"{int((counts > self.max_neighbors).sum())} queries have "
+                f"more than max_neighbors={self.max_neighbors} in-radius "
+                f"neighbors (max {worst}); raise max_neighbors, shrink the "
+                f"radius, or pass strict=False to vote among the nearest "
+                f"{self.max_neighbors}"
+            )
+        idx = np.asarray(idx)
+        labels = np.asarray(self._labels)[np.clip(idx, 0, None)]
+        labels = np.where(idx == SENTINEL_IDX, -1, labels)  # one_hot drops -1
+        pred = np.asarray(majority_vote(jnp.asarray(labels), self.num_classes))
+        outliers = counts == 0
+        if outliers.any():
+            if self.outlier_label is None:
+                raise ValueError(
+                    f"{int(outliers.sum())} queries have no neighbors within "
+                    f"radius {self.radius}; widen the radius or set "
+                    f"outlier_label"
+                )
+            pred = np.where(outliers, np.int32(self.outlier_label), pred)
+        return jnp.asarray(pred)
+
+    def score(self, Q, y) -> float:
+        pred = np.asarray(self.predict(Q))
+        return float(np.mean(pred == np.asarray(y)))

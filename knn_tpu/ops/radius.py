@@ -1,0 +1,151 @@
+"""Fixed-radius neighbor search — the radius counterpart of top-k.
+
+Beyond the reference (which only does top-K, knn_mpi.cpp:315-338), but a
+standard neighbor-API surface its users expect.  Variable-length results
+are TPU-hostile (dynamic shapes defeat XLA), so the formulation is
+bounded-width:
+
+- the result rows are the lexicographic nearest-``max_neighbors`` prefix
+  (ops.topk semantics — ties to the lower index), masked to the radius:
+  entries beyond it carry ``+inf`` distance and index ``SENTINEL_IDX``;
+  in-radius entries form a contiguous ascending-distance prefix;
+- a second matmul-bound tiled pass (:func:`count_within`) counts ALL
+  rows inside the radius with the same float32 distance arithmetic as
+  the selection, so truncation (``counts > max_neighbors``) is always
+  visible to the caller — never a silently incomplete result.
+
+Radius units follow each metric's RANKING space returned by
+ops.distance.pairwise_distance: the l2 family takes a true Euclidean
+radius (thresholded against squared distances internally), l1 a raw
+Manhattan radius, cosine a cosine-distance (1 - similarity) radius.
+``dot`` has no radius semantics (scores are unbounded similarities) and
+is rejected.  Membership of points within float32 rounding of the
+boundary follows the f32 arithmetic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from knn_tpu.ops.distance import pairwise_distance
+from knn_tpu.ops.topk import knn_search_tiled
+
+#: masked index value for beyond-radius slots (sklearn-style -1; the
+#: int32-max sentinel of ops.topk marks *padding*, a different thing)
+SENTINEL_IDX = -1
+
+
+def radius_threshold(radius: float, metric: str) -> float:
+    """The ranking-space threshold for a user-units ``radius``."""
+    m = metric.lower()
+    if m in ("l2", "sql2", "euclidean"):
+        if radius < 0:
+            raise ValueError(f"radius must be >= 0, got {radius}")
+        return float(radius) ** 2  # ranking space is squared L2
+    if m in ("l1", "manhattan", "cityblock", "cosine"):
+        if radius < 0:
+            raise ValueError(f"radius must be >= 0, got {radius}")
+        return float(radius)
+    raise ValueError(
+        f"radius semantics undefined for metric {metric!r} "
+        "(dot similarities are unbounded)"
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("metric", "tile", "compute_dtype")
+)
+def count_within(
+    db: jax.Array,
+    queries: jax.Array,
+    threshold,
+    metric: str = "l2",
+    *,
+    tile: int = 131072,
+    compute_dtype=None,
+    n_valid=None,
+) -> jax.Array:
+    """Per query, how many db rows lie at ranking-space distance
+    ``<= threshold`` — one tiled matmul-bound pass, no selection.
+
+    [Q] int32.  ``threshold`` is scalar or [Q] (already in ranking
+    space — callers convert via :func:`radius_threshold`).  Same
+    distance arithmetic as the selection path, so the count and the
+    mask agree including float32 boundary behavior.  ``n_valid`` masks
+    trailing padding rows (the db-shard contract of ops.topk).
+
+    Deliberately separate from ops.certified.count_below despite the
+    similar tiling: count_below's arithmetic (expanded-square minus
+    query norm, strict ``<``) is PINNED by the certificate's f32 error
+    model (certification_tolerance) and must not drift, while this pass
+    is metric-general with ``<=`` and follows pairwise_distance."""
+    n = db.shape[0]
+    tile = min(tile, n)
+    limit = n if n_valid is None else jnp.minimum(n, n_valid)
+    n_tiles = -(-n // tile)
+    padded = n_tiles * tile
+    if padded != n:
+        db = jnp.pad(db, ((0, padded - n), (0, 0)))
+    tiles = db.reshape(n_tiles, tile, db.shape[-1])
+    thr = jnp.asarray(threshold, jnp.float32)
+    thr_col = thr[..., None] if thr.ndim else thr
+
+    def step(acc, args):
+        tile_idx, t = args
+        d = pairwise_distance(queries, t, metric, compute_dtype=compute_dtype)
+        gidx = tile_idx * tile + lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+        ok = (d <= thr_col) & (gidx < limit)
+        return acc + jnp.sum(ok, axis=-1, dtype=jnp.int32), None
+
+    counts, _ = lax.scan(
+        step,
+        jnp.zeros(queries.shape[0], jnp.int32),
+        (jnp.arange(n_tiles, dtype=jnp.int32), tiles),
+    )
+    return counts
+
+
+def radius_search(
+    queries: jax.Array,
+    db: jax.Array,
+    radius: float,
+    *,
+    max_neighbors: int,
+    metric: str = "l2",
+    train_tile: Optional[int] = None,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """All neighbors within ``radius``, up to ``max_neighbors`` per query.
+
+    Returns ``(dists [Q, M], idx [Q, M], counts [Q])`` with
+    ``M = min(max_neighbors, n_db)``: the nearest-M prefix masked to the
+    radius (beyond-radius slots: ``+inf`` / ``SENTINEL_IDX``), plus the
+    EXACT within-radius count per query.  ``counts[q] > M`` means query
+    ``q``'s result is truncated to its M nearest — detectable, never
+    silent.  Distances are in ranking space (squared for the l2 family;
+    callers wanting Euclidean values apply ops.distance.metric_values).
+    """
+    thr = radius_threshold(radius, metric)
+    m = min(int(max_neighbors), db.shape[0])
+    if m < 1:
+        raise ValueError(f"max_neighbors must be >= 1, got {max_neighbors}")
+    d, i = knn_search_tiled(
+        queries, db, m, metric,
+        train_tile=train_tile, compute_dtype=compute_dtype,
+    )
+    counts = count_within(
+        db, queries, thr, metric,
+        tile=min(train_tile or 131072, db.shape[0]),
+        compute_dtype=compute_dtype,
+    )
+    within = d <= thr
+    return (
+        jnp.where(within, d, jnp.inf),
+        jnp.where(within, i, SENTINEL_IDX),
+        counts,
+    )

@@ -463,6 +463,102 @@ class ShardedKNN:
             d = metric_values(d, self.metric)
         return d[:n_q], i[:n_q]
 
+    def radius_search(self, queries, radius: float, *, max_neighbors: int):
+        """All db rows within ``radius`` per query, bounded at
+        ``max_neighbors`` — the sharded form of ops.radius.radius_search.
+
+        Returns ``(dists [Q, M], idx [Q, M], counts [Q])``: the sharded
+        nearest-M select masked to the radius (beyond-radius slots
+        ``+inf`` / ``-1``) plus the within-radius count from the
+        distributed count program (psum over the db axis) — truncation
+        (``counts > M``, with ``M = min(max_neighbors, n_train)``) is
+        always visible.  l2 family (Euclidean-units radius, squared
+        ranking values) and cosine (cosine-distance radius; db rows were
+        unit-normalized at placement, queries here; the count runs on
+        the unit-vector squared-L2 equivalent ``2 * (1 - sim)``).  L1
+        has no sharded count program and uses the single-device
+        ops.radius path instead.
+
+        Boundary contract: the mask (the sharded select's values) and
+        the count (the count program) are DIFFERENT XLA programs, so a
+        row within a float32 ulp of the radius can land on different
+        sides in each — counts may differ from the visible in-radius
+        entries by such boundary rows, and near-tied in-radius entries
+        may ORDER differently than the single-device path (each program
+        is lexicographic over its own f32 values).  Decisive semantics
+        need a radius off the data's distance values (cf. tests'
+        _safe_radius); this is inherent to f32 multi-program arithmetic,
+        unlike the single-device ops.radius path whose mask and count
+        share one pairwise computation.  bf16 placements are refused outright —
+        a bf16-ranked mask against an f32 count would widen the
+        boundary band ~2000x."""
+        from knn_tpu.ops.radius import SENTINEL_IDX, radius_threshold
+
+        if self._dtype_key is not None:
+            raise ValueError(
+                f"radius_search needs a float32 placement; this program "
+                f"was built with compute_dtype={self._dtype_key!r} and "
+                f"its mask/count arithmetics would disagree at the "
+                f"radius boundary"
+            )
+        thr = radius_threshold(radius, self.metric)  # ranking space
+        if self.metric == "cosine":
+            if not self._cosine_unit:
+                raise ValueError(
+                    "cosine radius_search needs the database normalized at "
+                    "placement; construct ShardedKNN from a host array"
+                )
+            count_thr = 2.0 * thr  # unit rows: ||q^-t^||^2 = 2 (1 - sim)
+            q_count = _row_normalize_f64(np.asarray(queries, np.float32))
+        elif self.metric in ("l2", "sql2", "euclidean"):
+            count_thr = thr
+            q_count = queries
+        else:
+            raise ValueError(
+                f"sharded radius_search supports l2/cosine, not "
+                f"{self.metric!r}; use ops.radius.radius_search"
+            )
+        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        m = min(int(max_neighbors), self.n_train)
+        if m < 1:
+            raise ValueError(f"max_neighbors must be >= 1, got {max_neighbors}")
+        if m > shard_rows:
+            # NEVER silently narrow: a caller testing counts > M for
+            # truncation would read a shard-clamped result as complete
+            # (same contract as search()'s k check above)
+            raise ValueError(
+                f"max_neighbors={m} exceeds db shard size {shard_rows}; "
+                f"use fewer db shards"
+            )
+        d, i = self.search(queries, k=m)
+        d, i = np.asarray(d), np.asarray(i)
+        # counts: the distributed count-below pass (strictly <);
+        # nextafter lifts it to <= in float32.  The l2 branch pays a
+        # second h2d placement of the same queries (search placed its
+        # own copy internally) — only the cosine branch genuinely needs
+        # a different (renormalized) placement; accepted because the
+        # count pass needs a query placement either way and search()
+        # does not expose its internal one.
+        count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
+        qp, n_q = self._place_queries(np.asarray(q_count, np.float32))
+        thr_vec = np.full(
+            qp.shape[0],
+            np.nextafter(np.float32(count_thr), np.float32(np.inf)),
+            np.float32,
+        )
+        out = _retry_transient(
+            lambda: count_fn(qp, self._tp, thr_vec), "radius count dispatch")
+        counts = _fetch_or_redispatch(
+            out, lambda: count_fn(qp, self._tp, thr_vec),
+            "radius count fetch",
+        )[:n_q]
+        within = d <= thr
+        return (
+            np.where(within, d, np.inf),
+            np.where(within, i, SENTINEL_IDX),
+            counts,
+        )
+
     # -- certified-exact path (ops.certified, distributed) -----------------
     def _host_train(self) -> np.ndarray:
         """Host copy of the (unpadded) database for float64 refinement;

@@ -1,0 +1,230 @@
+"""Radius-neighbors search + classifier vs float64 NumPy oracles.
+
+Radii are chosen at the midpoint of the widest inter-distance gap near a
+target quantile of the fixture's true distance distribution — nonempty
+neighbor sets AND boundary-safe by construction (float32-vs-float64
+arithmetic cannot flip membership, the documented ops.radius contract).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from knn_tpu.models.radius import RadiusNeighborsClassifier
+from knn_tpu.ops.radius import (
+    SENTINEL_IDX,
+    count_within,
+    radius_search,
+    radius_threshold,
+)
+from knn_tpu.parallel import ShardedKNN, make_mesh
+
+
+def _oracle_d(db, q, metric):
+    db64, q64 = db.astype(np.float64), q.astype(np.float64)
+    if metric == "l2":
+        return np.sqrt(((db64[None] - q64[:, None]) ** 2).sum(-1))
+    if metric == "l1":
+        return np.abs(db64[None] - q64[:, None]).sum(-1)
+    dn = db64 / np.linalg.norm(db64, axis=-1, keepdims=True)
+    qn = q64 / np.linalg.norm(q64, axis=-1, keepdims=True)
+    return 1.0 - qn @ dn.T  # cosine
+
+
+def _safe_radius(d, quantile):
+    """A radius at the midpoint of the widest gap between consecutive
+    distance values near the target quantile — every point sits at least
+    half that gap from the boundary."""
+    flat = np.sort(d.ravel())
+    target = np.quantile(flat, quantile)
+    lo = np.searchsorted(flat, target * 0.9)
+    hi = np.searchsorted(flat, target * 1.1)
+    seg = flat[max(lo, 1) - 1 : min(hi + 1, flat.size)]
+    gaps = np.diff(seg)
+    j = int(np.argmax(gaps))
+    radius = float((seg[j] + seg[j + 1]) / 2)
+    assert gaps[j] > 4e-4 * max(radius, 1.0), "no safe gap in fixture"
+    return radius
+
+
+def _sets(d, radius):
+    return [set(np.flatnonzero(row <= radius).tolist()) for row in d]
+
+
+@pytest.fixture
+def data(rng):
+    db = (rng.random((400, 12)) * 10).astype(np.float32)
+    q = (rng.random((25, 12)) * 10).astype(np.float32)
+    return db, q
+
+
+@pytest.mark.parametrize("metric", ["l2", "l1", "cosine"])
+def test_radius_search_matches_oracle(data, metric):
+    db, q = data
+    d64 = _oracle_d(db, q, metric)
+    radius = _safe_radius(d64, 0.02)
+    sets = _sets(d64, radius)
+    assert sum(len(s) for s in sets) > 25  # fixture is non-vacuous
+    M = max(len(s) for s in sets) + 3
+    d, i, counts = radius_search(q, db, radius, max_neighbors=M,
+                                 metric=metric, train_tile=128)
+    d, i, counts = np.asarray(d), np.asarray(i), np.asarray(counts)
+    for qi, want in enumerate(sets):
+        got = set(i[qi][i[qi] != SENTINEL_IDX].tolist())
+        assert got == want, (metric, qi)
+        assert counts[qi] == len(want)
+        # in-radius entries form an ascending-distance prefix
+        row = d[qi]
+        finite = row[np.isfinite(row)]
+        assert (np.diff(finite) >= 0).all()
+        assert np.isinf(row[len(finite):]).all()
+
+
+def test_radius_truncation_is_reported(data):
+    db, q = data
+    d64 = _oracle_d(db, q, "l2")
+    radius = _safe_radius(d64, 0.10)  # dense sets
+    sets = _sets(d64, radius)
+    sizes = sorted(len(s) for s in sets)
+    M = max(2, sizes[len(sizes) // 2])  # truncates the densest ~half
+    assert sizes[-1] > M  # the fixture genuinely truncates somewhere
+    d, i, counts = radius_search(q, db, radius, max_neighbors=M, metric="l2")
+    counts = np.asarray(counts)
+    # counts stay EXACT even when the result is truncated
+    assert [int(c) for c in counts] == [len(s) for s in sets]
+    assert (counts > M).any()
+    # truncated rows are full: all M slots in-radius
+    for qi in np.flatnonzero(counts > M):
+        assert (np.asarray(i[qi]) != SENTINEL_IDX).all()
+
+
+def test_count_within_per_query_thresholds(data, rng):
+    db, q = data
+    d64sq = _oracle_d(db, q, "l2") ** 2
+    # per-query thresholds: each query gets its own radius, each chosen
+    # boundary-safely from ITS OWN distance row
+    thr = np.asarray(
+        [radius_threshold(_safe_radius(row[None], 0.05), "l2")
+         for row in np.sqrt(d64sq)], np.float32)
+    counts = np.asarray(count_within(jnp.asarray(db), jnp.asarray(q), thr,
+                                     "l2", tile=96))
+    want = (d64sq <= thr[:, None].astype(np.float64)).sum(-1)
+    np.testing.assert_array_equal(counts, want)
+
+
+def test_radius_rejects_dot_metric(data):
+    db, q = data
+    with pytest.raises(ValueError, match="radius semantics"):
+        radius_search(q, db, 1.0, max_neighbors=8, metric="dot")
+    with pytest.raises(ValueError, match="radius must be"):
+        radius_search(q, db, -1.0, max_neighbors=8, metric="l2")
+
+
+class TestClassifier:
+    def _clustered(self, rng):
+        centers = rng.normal(size=(3, 8)).astype(np.float32) * 12
+        y = (np.arange(240) % 3).astype(np.int32)
+        X = centers[y] + rng.normal(size=(240, 8)).astype(np.float32)
+        return X, y, centers
+
+    def test_predict_matches_knn_within_radius(self, rng):
+        X, y, centers = self._clustered(rng)
+        q = centers[np.arange(30) % 3] + rng.normal(
+            size=(30, 8)).astype(np.float32) * 0.5
+        clf = RadiusNeighborsClassifier(
+            8.0, max_neighbors=240, metric="l2").fit(X, y)
+        pred = np.asarray(clf.predict(q))
+        assert (pred == (np.arange(30) % 3)).all()
+        assert clf.score(q, np.arange(30) % 3) == 1.0
+
+    def test_outlier_raises_then_labels(self, rng):
+        X, y, centers = self._clustered(rng)
+        far = np.full((2, 8), 1e4, np.float32)
+        clf = RadiusNeighborsClassifier(
+            8.0, max_neighbors=240, metric="l2").fit(X, y)
+        with pytest.raises(ValueError, match="no neighbors within"):
+            clf.predict(far)
+        clf2 = RadiusNeighborsClassifier(
+            8.0, max_neighbors=240, metric="l2", outlier_label=7).fit(X, y)
+        assert (np.asarray(clf2.predict(far)) == 7).all()
+
+    def test_strict_truncation_raises_then_votes_nearest(self, rng):
+        X, y, _ = self._clustered(rng)
+        q = X[:4]
+        clf = RadiusNeighborsClassifier(
+            50.0, max_neighbors=16, metric="l2").fit(X, y)  # radius >> data
+        with pytest.raises(ValueError, match="more than max_neighbors"):
+            clf.predict(q)
+        loose = RadiusNeighborsClassifier(
+            50.0, max_neighbors=16, metric="l2", strict=False).fit(X, y)
+        # nearest-16 vote == plain 16-NN vote here (all within radius)
+        from knn_tpu.models.classifier import KNNClassifier
+
+        knn = KNNClassifier(k=16, metric="l2").fit(X, y)
+        np.testing.assert_array_equal(
+            np.asarray(loose.predict(q)), np.asarray(knn.predict(q)))
+
+    def test_vote_tie_break_matches_reference_semantics(self):
+        # all-equidistant duplicates: label 1 reaches the tied max first
+        # in (distance, index) order — the knn_mpi.cpp:324-336 rule
+        X = np.zeros((6, 4), np.float32)
+        y = np.array([2, 1, 1, 2, 0, 0], np.int32)
+        clf = RadiusNeighborsClassifier(
+            1.0, max_neighbors=6, metric="l2").fit(X, y)
+        assert int(np.asarray(clf.predict(np.zeros((1, 4), np.float32)))[0]) == 1
+
+
+def test_sharded_radius_matches_single_device(data):
+    db, q = data
+    d64 = _oracle_d(db, q, "l2")
+    radius = _safe_radius(d64, 0.02)
+    M = max(len(s) for s in _sets(d64, radius)) + 3
+    ref_d, ref_i, ref_c = radius_search(q, db, radius, max_neighbors=M,
+                                        metric="l2")
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5)
+    d, i, c = prog.radius_search(q, radius, max_neighbors=M)
+    # counts and per-row MEMBERSHIP are exact; positional order can swap
+    # for near-tied rows whose f32 values differ by an ulp between the
+    # two program structures (each program is internally lexicographic
+    # over ITS OWN values), and values agree to f32 ulps only
+    np.testing.assert_array_equal(c, np.asarray(ref_c))
+    ref_i = np.asarray(ref_i)
+    for qi in range(q.shape[0]):
+        assert (set(i[qi][i[qi] >= 0].tolist())
+                == set(ref_i[qi][ref_i[qi] >= 0].tolist())), qi
+    ref_d = np.asarray(ref_d)
+    np.testing.assert_array_equal(np.isinf(d), np.isinf(ref_d))
+    np.testing.assert_allclose(d[np.isfinite(d)], ref_d[np.isfinite(ref_d)],
+                               rtol=1e-5)
+
+
+def test_sharded_radius_guards(data):
+    db, q = data
+    # bf16 placements are refused: the bf16-ranked mask vs f32 count
+    # would widen the boundary band ~2000x
+    prog16 = ShardedKNN(db, mesh=make_mesh(8, 1), k=5,
+                        compute_dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="float32 placement"):
+        prog16.radius_search(q, 5.0, max_neighbors=8)
+    # a max_neighbors wider than the db shard must RAISE, never silently
+    # narrow (counts > M truncation detection would misread a clamped
+    # result as complete)
+    prog = ShardedKNN(db, mesh=make_mesh(1, 8), k=5)  # 50-row shards
+    with pytest.raises(ValueError, match="exceeds db shard size"):
+        prog.radius_search(q, 5.0, max_neighbors=128)
+
+
+def test_sharded_radius_cosine(data):
+    db, q = data
+    d64 = _oracle_d(db, q, "cosine")
+    radius = _safe_radius(d64, 0.02)
+    sets = _sets(d64, radius)
+    assert sum(len(s) for s in sets) > 25
+    M = max(len(s) for s in sets) + 3
+    prog = ShardedKNN(db, mesh=make_mesh(2, 4), k=5, metric="cosine")
+    d, i, c = prog.radius_search(q, radius, max_neighbors=M)
+    for qi, want in enumerate(sets):
+        got = set(i[qi][i[qi] != SENTINEL_IDX].tolist())
+        assert got == want, qi
+        assert c[qi] == len(want)
