@@ -49,6 +49,7 @@ _EXPORTS = {
     "knn_predict": "knn_tpu.models.classifier",
     "KNNRegressor": "knn_tpu.models.regressor",
     "RadiusNeighborsClassifier": "knn_tpu.models.radius",
+    "RadiusNeighborsRegressor": "knn_tpu.models.radius",
     "radius_search": "knn_tpu.ops.radius",
     "count_within": "knn_tpu.ops.radius",
     "JobConfig": "knn_tpu.utils.config",

@@ -1,11 +1,13 @@
-"""Radius-neighbors classifier — fixed-radius voting on top of
+"""Radius-neighbors estimators — fixed-radius voting/regression on top of
 ops.radius (beyond the reference's fixed-K vote, same vote semantics).
 
-The vote among in-radius neighbors reuses the reference's exact
-first-to-reach-max tie-break (ops.vote, knn_mpi.cpp:324-336): in-radius
-neighbors form the ascending-distance prefix of the bounded result, and
-masked slots carry label -1, which ``jax.nn.one_hot`` drops from the
-histogram — so the running-argmax semantics carry over unchanged.
+The classifier's vote among in-radius neighbors reuses the reference's
+exact first-to-reach-max tie-break (ops.vote, knn_mpi.cpp:324-336):
+in-radius neighbors form the ascending-distance prefix of the bounded
+result, and masked slots carry label -1, which ``jax.nn.one_hot`` drops
+from the histogram — so the running-argmax semantics carry over
+unchanged.  The regressor aggregates in-radius targets (uniform mean or
+inverse-distance weights, the same weighting home as KNNRegressor).
 """
 
 from __future__ import annotations
@@ -20,24 +22,10 @@ from knn_tpu.ops.radius import SENTINEL_IDX, radius_search
 from knn_tpu.ops.vote import majority_vote
 
 
-class RadiusNeighborsClassifier:
-    """Classify by majority vote among all training points within
-    ``radius`` of the query (nearest ``max_neighbors`` of them when more
-    are inside — see ``strict``).
-
-    Args:
-      radius: metric-units radius (Euclidean for l2 — see
-        ops.radius.radius_threshold).
-      max_neighbors: bounded result width M (TPU needs static shapes).
-        ``strict=True`` (default) raises when any query has more than M
-        in-radius neighbors, so the vote is never silently truncated;
-        ``strict=False`` votes among the nearest M — a documented
-        approximation, with the exact counts still available via
-        :meth:`radius_neighbors`.
-      outlier_label: label for queries with ZERO in-radius neighbors;
-        None (default) raises on the first outlier instead.
-      metric / normalize / train_tile / compute_dtype: as KNNClassifier.
-    """
+class _RadiusNeighborsBase:
+    """Shared fit / query-prep / bounded radius search / truncation guard
+    of the radius estimators.  See RadiusNeighborsClassifier for the
+    parameter semantics."""
 
     def __init__(
         self,
@@ -45,11 +33,9 @@ class RadiusNeighborsClassifier:
         *,
         max_neighbors: int = 128,
         metric: str = "l2",
-        num_classes: Optional[int] = None,
         normalize: bool = False,
         train_tile: Optional[int] = None,
         compute_dtype=None,
-        outlier_label: Optional[int] = None,
         strict: bool = True,
     ):
         from knn_tpu.ops.radius import radius_threshold
@@ -58,29 +44,31 @@ class RadiusNeighborsClassifier:
         self.radius = radius
         self.max_neighbors = max_neighbors
         self.metric = metric
-        self.num_classes = num_classes
         self.normalize = normalize
         self.train_tile = train_tile
         self.compute_dtype = compute_dtype
-        self.outlier_label = outlier_label
         self.strict = strict
         self._train = None
-        self._labels = None
+        self._y = None
         self._mins = None
         self._maxs = None
 
-    def fit(self, X, y) -> "RadiusNeighborsClassifier":
+    def _fit_targets(self, y):  # subclass: dtype/validation of y
+        raise NotImplementedError
+
+    def fit(self, X, y):
         X = jnp.asarray(X)
-        y = jnp.asarray(y, dtype=jnp.int32)
-        if X.ndim != 2 or y.ndim != 1 or X.shape[0] != y.shape[0]:
-            raise ValueError(f"bad shapes: X {X.shape}, y {y.shape}")
-        if self.num_classes is None:
-            self.num_classes = int(jnp.max(y)) + 1
+        y_raw = jnp.asarray(y)
+        # shape compatibility BEFORE subclass target processing: a failed
+        # fit must leave no half-inferred state (e.g. num_classes) behind
+        if X.ndim != 2 or X.shape[0] != y_raw.shape[0]:
+            raise ValueError(f"bad shapes: X {X.shape}, y {y_raw.shape}")
+        y = self._fit_targets(y_raw)
         if self.normalize:
             self._mins, self._maxs = minmax_stats([X])
             X = minmax_apply(X, self._mins, self._maxs)
         self._train = X
-        self._labels = y
+        self._y = y
         return self
 
     def _require_fit(self):
@@ -104,21 +92,64 @@ class RadiusNeighborsClassifier:
             train_tile=self.train_tile, compute_dtype=self.compute_dtype,
         )
 
-    def predict(self, Q):
-        self._require_fit()
-        _, idx, counts = self.radius_neighbors(Q)
+    def _checked_neighbors(self, Q):
+        """radius_neighbors + the strict truncation guard, as numpy."""
+        d, idx, counts = self.radius_neighbors(Q)
         counts = np.asarray(counts)
         if self.strict and (counts > self.max_neighbors).any():
-            worst = int(counts.max())
             raise ValueError(
                 f"{int((counts > self.max_neighbors).sum())} queries have "
                 f"more than max_neighbors={self.max_neighbors} in-radius "
-                f"neighbors (max {worst}); raise max_neighbors, shrink the "
-                f"radius, or pass strict=False to vote among the nearest "
-                f"{self.max_neighbors}"
+                f"neighbors (max {int(counts.max())}); raise max_neighbors, "
+                f"shrink the radius, or pass strict=False to aggregate the "
+                f"nearest {self.max_neighbors}"
             )
-        idx = np.asarray(idx)
-        labels = np.asarray(self._labels)[np.clip(idx, 0, None)]
+        return np.asarray(d), np.asarray(idx), counts
+
+
+class RadiusNeighborsClassifier(_RadiusNeighborsBase):
+    """Classify by majority vote among all training points within
+    ``radius`` of the query (nearest ``max_neighbors`` of them when more
+    are inside — see ``strict``).
+
+    Args:
+      radius: metric-units radius (Euclidean for l2 — see
+        ops.radius.radius_threshold).
+      max_neighbors: bounded result width M (TPU needs static shapes).
+        ``strict=True`` (default) raises when any query has more than M
+        in-radius neighbors, so the vote is never silently truncated;
+        ``strict=False`` votes among the nearest M — a documented
+        approximation, with the exact counts still available via
+        :meth:`radius_neighbors`.
+      outlier_label: label for queries with ZERO in-radius neighbors;
+        None (default) raises on the first outlier instead.
+      metric / normalize / train_tile / compute_dtype: as KNNClassifier.
+    """
+
+    def __init__(
+        self,
+        radius: float,
+        *,
+        num_classes: Optional[int] = None,
+        outlier_label: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__(radius, **kwargs)
+        self.num_classes = num_classes
+        self.outlier_label = outlier_label
+
+    def _fit_targets(self, y):
+        y = jnp.asarray(y, dtype=jnp.int32)
+        if y.ndim != 1:
+            raise ValueError(f"labels must be 1-D, got {y.shape}")
+        if self.num_classes is None:
+            self.num_classes = int(jnp.max(y)) + 1
+        return y
+
+    def predict(self, Q):
+        self._require_fit()
+        _, idx, counts = self._checked_neighbors(Q)
+        labels = np.asarray(self._y)[np.clip(idx, 0, None)]
         labels = np.where(idx == SENTINEL_IDX, -1, labels)  # one_hot drops -1
         pred = np.asarray(majority_vote(jnp.asarray(labels), self.num_classes))
         outliers = counts == 0
@@ -135,3 +166,87 @@ class RadiusNeighborsClassifier:
     def score(self, Q, y) -> float:
         pred = np.asarray(self.predict(Q))
         return float(np.mean(pred == np.asarray(y)))
+
+
+class RadiusNeighborsRegressor(_RadiusNeighborsBase):
+    """Regress as the (optionally inverse-distance-weighted) mean target
+    over all training points within ``radius``.
+
+    ``weights``: 'uniform' | 'distance' (1/d, same convention as
+    KNNRegressor — l2 distances are sqrt'ed before weighting).
+    ``outlier_value``: prediction for queries with zero in-radius
+    neighbors; None (default) raises instead.  Other args as
+    RadiusNeighborsClassifier.
+    """
+
+    def __init__(
+        self,
+        radius: float,
+        *,
+        weights: str = "uniform",
+        outlier_value: Optional[float] = None,
+        **kwargs,
+    ):
+        if weights not in ("uniform", "distance"):
+            raise ValueError(f"unknown weights {weights!r}")
+        super().__init__(radius, **kwargs)
+        self.weights = weights
+        self.outlier_value = outlier_value
+
+    def _fit_targets(self, y):
+        return jnp.asarray(y, dtype=jnp.float32)
+
+    def predict(self, Q):
+        self._require_fit()
+        d, idx, counts = self._checked_neighbors(Q)
+        within = idx != SENTINEL_IDX
+        targets = np.asarray(self._y)[np.clip(idx, 0, None)].astype(np.float64)
+        if targets.ndim == 3:
+            within_t = within[..., None]
+        else:
+            within_t = within
+        n_sel = np.maximum(within.sum(axis=1), 1)
+        if self.weights == "uniform":
+            pred = (np.where(within_t, targets, 0.0).sum(axis=1)
+                    / (n_sel[:, None] if targets.ndim == 3 else n_sel))
+        else:
+            from knn_tpu.models.regressor import DIST_FLOOR, L2_FAMILY
+
+            # float64 weights: a float32 array would underflow the
+            # 1e-300 zero-sum guard below to 0 (0/0 on all-outlier rows)
+            dv = d.astype(np.float64)
+            if self.metric.lower() in L2_FAMILY:
+                dv = np.sqrt(np.maximum(dv, 0.0))  # ranking space is squared
+            w = np.where(within, 1.0 / np.maximum(dv, DIST_FLOOR), 0.0)
+            w = w / np.maximum(w.sum(axis=1, keepdims=True), 1e-300)
+            wt = w[..., None] if targets.ndim == 3 else w
+            pred = (wt * np.where(within_t, targets, 0.0)).sum(axis=1)
+        outliers = counts == 0
+        if outliers.any():
+            if self.outlier_value is None:
+                raise ValueError(
+                    f"{int(outliers.sum())} queries have no neighbors within "
+                    f"radius {self.radius}; widen the radius or set "
+                    f"outlier_value"
+                )
+            fill = np.float64(self.outlier_value)
+            pred = np.where(
+                outliers[:, None] if pred.ndim == 2 else outliers, fill, pred)
+        return jnp.asarray(pred.astype(np.float32))
+
+    def score(self, Q, y) -> float:
+        """R^2 (coefficient of determination), sklearn convention:
+        constant-y outputs score 1.0 when predicted exactly (else 0.0),
+        and multi-output y averages per-output R^2 uniformly."""
+        y = np.atleast_2d(np.asarray(y, dtype=np.float64).T).T
+        pred = np.atleast_2d(
+            np.asarray(self.predict(Q), dtype=np.float64).T).T
+        ss_res = ((y - pred) ** 2).sum(axis=0)
+        ss_tot = ((y - y.mean(axis=0)) ** 2).sum(axis=0)
+        varying = ss_tot > 0
+        r2 = np.where(
+            varying,
+            1.0 - ss_res / np.where(varying, ss_tot, 1.0),
+            np.where(ss_res == 0, 1.0, 0.0),
+        )
+        return float(r2.mean())

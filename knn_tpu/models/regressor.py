@@ -12,6 +12,14 @@ import jax.numpy as jnp
 
 from knn_tpu.ops.topk import knn_search_tiled
 
+#: ONE home for the inverse-distance weighting convention, shared with
+#: models.radius.RadiusNeighborsRegressor (which reimplements the
+#: arithmetic in numpy over masked arrays): the l2 family sqrt's its
+#: squared ranking values before weighting, and distances floor at
+#: DIST_FLOOR so exact duplicates don't divide by zero.
+L2_FAMILY = ("l2", "sql2", "euclidean")
+DIST_FLOOR = 1e-12
+
 
 @functools.partial(
     jax.jit, static_argnames=("k", "metric", "weights", "train_tile", "compute_dtype")
@@ -46,9 +54,9 @@ def _weighted_targets(dists, targets, weights: str, metric: str = "l2"):
     if weights == "uniform":
         return jnp.mean(targets, axis=1)
     if weights == "distance":
-        if metric.lower() in ("l2", "sql2", "euclidean"):
+        if metric.lower() in L2_FAMILY:
             dists = jnp.sqrt(jnp.maximum(dists, 0.0))
-        w = 1.0 / jnp.maximum(dists, 1e-12)  # [Q, k]
+        w = 1.0 / jnp.maximum(dists, DIST_FLOOR)  # [Q, k]
         w = w / jnp.sum(w, axis=1, keepdims=True)
         if targets.ndim == 3:
             w = w[..., None]

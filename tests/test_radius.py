@@ -175,6 +175,85 @@ class TestClassifier:
         assert int(np.asarray(clf.predict(np.zeros((1, 4), np.float32)))[0]) == 1
 
 
+class TestRegressor:
+    def test_uniform_matches_oracle(self, rng):
+        db = (rng.random((200, 10)) * 10).astype(np.float32)
+        yv = rng.normal(size=200).astype(np.float32)
+        q = (rng.random((15, 10)) * 10).astype(np.float32)
+        d64 = _oracle_d(db, q, "l2")
+        radius = _safe_radius(d64, 0.08)
+        sets = _sets(d64, radius)
+        assert all(sets), "fixture: every query needs >= 1 neighbor"
+        from knn_tpu.models.radius import RadiusNeighborsRegressor
+
+        reg = RadiusNeighborsRegressor(
+            radius, max_neighbors=max(len(s) for s in sets) + 2).fit(db, yv)
+        pred = np.asarray(reg.predict(q))
+        want = np.array([yv[sorted(s)].astype(np.float64).mean()
+                         for s in sets])
+        np.testing.assert_allclose(pred, want, rtol=1e-5)
+        assert reg.score(q, want) > 0.999999
+
+    def test_distance_weights_and_outliers(self, rng):
+        db = (rng.random((200, 10)) * 10).astype(np.float32)
+        yv = rng.normal(size=200).astype(np.float32)
+        q = (rng.random((10, 10)) * 10).astype(np.float32)
+        d64 = _oracle_d(db, q, "l2")
+        radius = _safe_radius(d64, 0.08)
+        sets = _sets(d64, radius)
+        from knn_tpu.models.radius import RadiusNeighborsRegressor
+
+        reg = RadiusNeighborsRegressor(
+            radius, max_neighbors=max(len(s) for s in sets) + 2,
+            weights="distance").fit(db, yv)
+        pred = np.asarray(reg.predict(q))
+        for qi, s in enumerate(sets):
+            idxs = sorted(s)
+            dd = d64[qi, idxs]
+            w = 1.0 / np.maximum(dd, 1e-12)
+            want = (w * yv[idxs].astype(np.float64)).sum() / w.sum()
+            np.testing.assert_allclose(pred[qi], want, rtol=1e-4)
+        # outliers: raise by default, fill when outlier_value given
+        far = np.full((2, 10), 1e4, np.float32)
+        with pytest.raises(ValueError, match="no neighbors"):
+            reg.predict(far)
+        reg2 = RadiusNeighborsRegressor(
+            radius, max_neighbors=64, outlier_value=-3.5).fit(db, yv)
+        assert (np.asarray(reg2.predict(far)) == np.float32(-3.5)).all()
+
+
+def test_failed_fit_leaves_no_inferred_state(rng):
+    # a shape-mismatched fit must NOT poison num_classes: the next
+    # (correct) fit would silently one-hot with too few bins
+    X = (rng.random((20, 4)) * 10).astype(np.float32)
+    clf = RadiusNeighborsClassifier(5.0, max_neighbors=8)
+    with pytest.raises(ValueError, match="bad shapes"):
+        clf.fit(X, np.array([0, 1, 2], np.int32))
+    assert clf.num_classes is None
+    clf.fit(X, (np.arange(20) % 10).astype(np.int32))
+    assert clf.num_classes == 10
+
+
+def test_regressor_score_sklearn_conventions(rng):
+    from knn_tpu.models.radius import RadiusNeighborsRegressor
+
+    X = (rng.random((30, 4)) * 10).astype(np.float32)
+    # constant targets predicted exactly -> R^2 = 1.0 (sklearn), not 0.0
+    reg = RadiusNeighborsRegressor(1e3, max_neighbors=30).fit(
+        X, np.ones(30, np.float32))
+    assert reg.score(X[:5], np.ones(5)) == 1.0
+    # multi-output: per-output R^2 averaged uniformly — an output with
+    # huge variance must not drown a poorly-predicted small one
+    y2 = np.stack([np.ones(30), np.arange(30, dtype=np.float64) * 100],
+                  axis=1).astype(np.float32)
+    reg2 = RadiusNeighborsRegressor(1e3, max_neighbors=30).fit(X, y2)
+    s = reg2.score(X[:6], np.stack(
+        [np.zeros(6), np.asarray(reg2.predict(X[:6]))[:, 1]], axis=1))
+    # output 0: constant truth (0) never predicted (pred=1) -> 0.0;
+    # output 1: exact -> 1.0; uniform average = 0.5
+    assert s == 0.5, s
+
+
 def test_sharded_radius_matches_single_device(data):
     db, q = data
     d64 = _oracle_d(db, q, "l2")
