@@ -103,6 +103,10 @@ try:
     #: select-phase layout (ops.pallas_knn.BINNINGS): "grouped" = lane-
     #: indexed bins, shuffle-free select (round-4); "lane" = round-3
     PALLAS_BINNING = os.environ.get("KNN_BENCH_PALLAS_BINNING", "grouped")
+    #: grid iteration order (ops.pallas_knn.GRID_ORDERS): "db_major"
+    #: streams each db tile once per sweep instead of once per query
+    #: block (r5 cost model); opt-in pending the hardware gate + A/B
+    PALLAS_GRID = os.environ.get("KNN_BENCH_PALLAS_GRID", "query_major")
     #: recall target of the one-pass path's final ApproxTopK (None =
     #: library default 0.999); misses surface as fallbacks, never
     #: as unsound certificates
@@ -559,6 +563,7 @@ def main() -> None:
                     block_q=PALLAS_BLOCK_Q,
                     final_select=PALLAS_FINAL, binning=PALLAS_BINNING,
                     final_recall_target=PALLAS_FINAL_RT,
+                    grid_order=PALLAS_GRID,
                     return_distances=return_distances,
                 )
                 return i, st
@@ -602,6 +607,7 @@ def main() -> None:
             survivors=PALLAS_SURVIVORS, block_q=PALLAS_BLOCK_Q,
             final_select=PALLAS_FINAL,
             binning=PALLAS_BINNING, final_recall_target=PALLAS_FINAL_RT,
+            grid_order=PALLAS_GRID,
         )
         pb_queries = queries
         if METRIC == "cosine":
@@ -743,6 +749,7 @@ def main() -> None:
             survivors=PALLAS_SURVIVORS, block_q=PALLAS_BLOCK_Q,
             final_select=PALLAS_FINAL,
             binning=PALLAS_BINNING, final_recall_target=PALLAS_FINAL_RT,
+            grid_order=PALLAS_GRID,
         )
         return {
             "pallas_gate_ok": bool((idx == oracle).all()),
@@ -922,6 +929,7 @@ def main() -> None:
             "bin_w": PALLAS_BIN_W, "survivors": PALLAS_SURVIVORS,
             "block_q": PALLAS_BLOCK_Q,
             "final_select": PALLAS_FINAL, "binning": PALLAS_BINNING,
+            "grid_order": PALLAS_GRID,
             "final_recall_target": PALLAS_FINAL_RT, "batch": PALLAS_BATCH,
             "margin": MARGIN,
         },

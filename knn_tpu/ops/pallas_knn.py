@@ -155,6 +155,25 @@ def _round_up(x: int, multiple: int) -> int:
 #: when the reduced axis is the sublane-group axis.
 BINNINGS = ("grouped", "lane")
 
+#: grid iteration orders.  "query_major" (default): grid =
+#: (q_blocks, db_tiles, dim_chunks) — every query block streams the
+#: FULL db through VMEM, so db HBM traffic scales with the query-block
+#: count (16 GB per 4096-query sweep at the SIFT shape, the largest
+#: term of the measured cost model in docs/PERF.md).  "db_major": grid =
+#: (db_tiles, q_blocks, dim_chunks) — consecutive steps revisit the
+#: same db tile (Pallas re-fetches an input block only when its mapped
+#: index changes), so AT dim <= DIM_CHUNK (nd == 1, e.g. SIFT's 128)
+#: each db tile streams ONCE per sweep and only the small query blocks
+#: re-stream (~2 MB x n_tiles).  For multi-chunk dims the innermost
+#: chunk axis cycles between query blocks, so every chunk re-fetches
+#: per query block — db traffic identical to query_major; the variant
+#: buys nothing there (gist/glove).  Candidate/bound
+#: outputs stay disjoint per (query block, db tile) cell in both orders
+#: — no output revisiting (the round-3 soundness lesson) either way.
+#: db_major is opt-in until the on-hardware gate + A/B pass on it
+#: (the same discipline the grouped select went through).
+GRID_ORDERS = ("query_major", "db_major")
+
 
 def _geometry(
     tile_n: int, bin_w: int = BIN_W, survivors: Optional[int] = None,
@@ -232,8 +251,8 @@ def effective_tile(
 
 def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
             survivors: int, out_w: int, bound_w: int, nd: int,
-            precision: str, binning: str):
-    ti = pl.program_id(1)
+            precision: str, binning: str, ti_axis: int = 1):
+    ti = pl.program_id(ti_axis)  # 1 = query_major grid, 0 = db_major
     di = pl.program_id(2)
     q = q_ref[:]
     dn = (((1,), (1,)), ((), ()))
@@ -403,7 +422,8 @@ def _on_tpu() -> bool:
 
 @functools.partial(
     jax.jit, static_argnames=("block_q", "tile_n", "bin_w", "survivors",
-                              "precision", "interpret", "binning")
+                              "precision", "interpret", "binning",
+                              "grid_order")
 )
 def _bin_candidates(
     queries: jax.Array,
@@ -416,6 +436,7 @@ def _bin_candidates(
     precision: str,
     interpret: bool,
     binning: str = "grouped",
+    grid_order: str = "query_major",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Kernel launch on padded shapes.  Returns
 
@@ -445,12 +466,27 @@ def _bin_candidates(
 
     if precision not in PRECISIONS:
         raise ValueError(f"precision {precision!r} not in {PRECISIONS}")
+    if grid_order not in GRID_ORDERS:
+        raise ValueError(f"grid_order {grid_order!r} not in {GRID_ORDERS}")
+    db_major = grid_order == "db_major"
     kernel = functools.partial(
         _kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
         survivors=survivors, out_w=out_w, bound_w=bound_w, nd=nd,
         precision=precision, binning=binning,
+        ti_axis=0 if db_major else 1,
     )
-    grid = (qp // block_q, n_tiles, nd)
+    if db_major:
+        grid = (n_tiles, qp // block_q, nd)
+        q_idx = lambda t, q, d: (q, d)      # noqa: E731
+        t_idx = lambda t, q, d: (t, d)      # noqa: E731
+        n_idx = lambda t, q, d: (0, t)      # noqa: E731
+        o_idx = lambda t, q, d: (q, t)      # noqa: E731
+    else:
+        grid = (qp // block_q, n_tiles, nd)
+        q_idx = lambda q, t, d: (q, d)      # noqa: E731
+        t_idx = lambda q, t, d: (t, d)      # noqa: E731
+        n_idx = lambda q, t, d: (0, t)      # noqa: E731
+        o_idx = lambda q, t, d: (q, t)      # noqa: E731
     kwargs = {}
     if not interpret:
         # the [block_q, tile_n] f32 score tile + double-buffered db
@@ -462,7 +498,11 @@ def _bin_candidates(
         # overflows still fails at compile time, never silently.
         score_mb = block_q * tile_n * 4 // (1024 * 1024)
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+            # db_major: the outer axis is the db tile, whose input block
+            # is revisited across inner steps — it must stay sequential
+            dimension_semantics=(
+                ("arbitrary", "arbitrary", "arbitrary") if db_major
+                else ("parallel", "arbitrary", "arbitrary")),
             vmem_limit_bytes=max(64, 3 * score_mb + 24) * 1024 * 1024,
         )
     if precision in ("bf16x3", "bf16x3f"):
@@ -473,8 +513,8 @@ def _bin_candidates(
         if precision == "bf16x3":
             db_inputs = [th, tl]
             db_specs = [
-                pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
-                pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+                pl.BlockSpec((tile_n, DIM_CHUNK), t_idx),
+                pl.BlockSpec((tile_n, DIM_CHUNK), t_idx),
             ]
         else:
             # per dim chunk c the fused contraction reads [th_c|tl_c|th_c]
@@ -484,26 +524,25 @@ def _bin_candidates(
                 db.shape[0], nd * 3 * DIM_CHUNK)
             db_inputs = [t3]
             db_specs = [
-                pl.BlockSpec((tile_n, 3 * DIM_CHUNK),
-                             lambda qi, ti, di: (ti, di)),
+                pl.BlockSpec((tile_n, 3 * DIM_CHUNK), t_idx),
             ]
     else:
         db_inputs = [db]
         db_specs = [
-            pl.BlockSpec((tile_n, DIM_CHUNK), lambda qi, ti, di: (ti, di)),
+            pl.BlockSpec((tile_n, DIM_CHUNK), t_idx),
         ]
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_q, DIM_CHUNK), lambda qi, ti, di: (qi, di)),
+            pl.BlockSpec((block_q, DIM_CHUNK), q_idx),
             *db_specs,
-            pl.BlockSpec((8, tile_n), lambda qi, ti, di: (0, ti)),
+            pl.BlockSpec((8, tile_n), n_idx),
         ],
         out_specs=[
-            pl.BlockSpec((block_q, out_w), lambda qi, ti, di: (qi, ti)),
-            pl.BlockSpec((block_q, out_w), lambda qi, ti, di: (qi, ti)),
-            pl.BlockSpec((block_q, bound_w), lambda qi, ti, di: (qi, ti)),
+            pl.BlockSpec((block_q, out_w), o_idx),
+            pl.BlockSpec((block_q, out_w), o_idx),
+            pl.BlockSpec((block_q, bound_w), o_idx),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.float32),
@@ -525,7 +564,7 @@ def _bin_candidates(
     jax.jit,
     static_argnames=("m", "tile_n", "block_q", "bin_w", "survivors",
                      "precision", "final_select", "interpret", "binning",
-                     "final_recall_target"),
+                     "final_recall_target", "grid_order"),
 )
 def local_certified_candidates(
     q: jax.Array,
@@ -541,6 +580,7 @@ def local_certified_candidates(
     interpret: Optional[bool] = None,
     binning: str = "grouped",
     final_recall_target: Optional[float] = None,
+    grid_order: str = "query_major",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole device-side certified coarse pass against one db (shard):
 
@@ -573,7 +613,7 @@ def local_certified_candidates(
     cd, ci, bounds = _bin_candidates(
         q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
         bin_w=bin_w, survivors=survivors, precision=precision,
-        interpret=interpret, binning=binning,
+        interpret=interpret, binning=binning, grid_order=grid_order,
     )
     n_q = q.shape[0]
     cd, ci, bounds = cd[:n_q], ci[:n_q], bounds[:n_q]
@@ -711,6 +751,7 @@ def knn_search_pallas(
     final_select: str = "exact",
     binning: str = "grouped",
     final_recall_target: Optional[float] = None,
+    grid_order: str = "query_major",
 ) -> Tuple[np.ndarray, np.ndarray, dict]:
     """Certified-exact KNN in ONE database pass on a single-device mesh:
     fused kernel coarse select -> device rank -> exclusion-bound
@@ -744,6 +785,7 @@ def knn_search_pallas(
         bin_w=bin_w, survivors=survivors, block_q=block_q,
         final_select=final_select,
         binning=binning, final_recall_target=final_recall_target,
+        grid_order=grid_order,
     )
 
 

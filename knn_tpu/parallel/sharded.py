@@ -594,6 +594,7 @@ class ShardedKNN:
         recall_target: Optional[float] = None,
         binning: str = "grouped",
         final_recall_target: Optional[float] = None,
+        grid_order: str = "query_major",
         return_sqrt: bool = False,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
@@ -706,6 +707,7 @@ class ShardedKNN:
                 bin_w=bin_w, survivors=survivors, block_q=block_q,
                 final_select=final_select, binning=binning,
                 final_recall_target=final_recall_target,
+                grid_order=grid_order,
             )
         else:
             bad = self._certify_counted(
@@ -859,7 +861,8 @@ class ShardedKNN:
                       final_select: str = "exact",
                       include_distances: bool = True,
                       binning: str = "grouped",
-                      final_recall_target: Optional[float] = None):
+                      final_recall_target: Optional[float] = None,
+                      grid_order: str = "query_major"):
         """(program, m, analysis_window) for the one-pass certified
         path — the ONE home of the kernel-geometry margin cap and the
         packed-output window, shared by :meth:`_certify_pallas` and
@@ -913,6 +916,7 @@ class ShardedKNN:
             block_q=block_q, final_select=final_select,
             include_distances=include_distances, binning=binning,
             final_recall_target=final_recall_target,
+            grid_order=grid_order,
         )
         return prog, m, _analysis_window(self.k, m)
 
@@ -920,7 +924,7 @@ class ShardedKNN:
         self, batches, bs, m, d, i, q_np, db_np, db_norm_max, *,
         tile_n, precision, want_distances=True, bin_w=None, survivors=None,
         block_q=None, final_select="exact", binning="grouped",
-        final_recall_target=None,
+        final_recall_target=None, grid_order="query_major",
     ):
         """One-pass certificate, host side.  The device already ranked the
         candidates, flagged uncertified rows, and marked near-tie pairs
@@ -939,7 +943,8 @@ class ShardedKNN:
                                         final_select=final_select,
                                         include_distances=want_distances,
                                         binning=binning,
-                                        final_recall_target=final_recall_target)
+                                        final_recall_target=final_recall_target,
+                                        grid_order=grid_order)
 
         # stage 1: dispatch every batch (async on device)
         norm_op = np.float32(db_norm_max)
@@ -1096,6 +1101,7 @@ def _pallas_certified_program(
     block_q: Optional[int] = None, final_select: str = "exact",
     include_distances: bool = True, binning: str = "grouped",
     final_recall_target: Optional[float] = None,
+    grid_order: str = "query_major",
 ):
     """ONE-pass sharded self-certifying coarse select + device rank +
     device certificate (ops.pallas_knn.local_certified_candidates per
@@ -1145,6 +1151,7 @@ def _pallas_certified_program(
             q, t, m, tile_n=eff_tile, bin_w=eff_bin, survivors=survivors,
             block_q=eff_bq, final_select=final_select, precision=precision,
             binning=binning, final_recall_target=final_recall_target,
+            grid_order=grid_order,
         )
         db_idx = lax.axis_index(DB_AXIS)
         gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,

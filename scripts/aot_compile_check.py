@@ -108,6 +108,22 @@ def main() -> int:
          (qs, db), dict(m=128, block_q=256, tile_n=32768,
                         final_select="exact", interpret=False,
                         binning="grouped")),
+        # db-major grid order: each db tile streams ONCE per sweep
+        # (docs/PERF.md cost model says query-major's db re-streaming is
+        # the largest kernel term); interpret-mode bitwise-equal to
+        # query-major, hardware A/B + gate decide adoption
+        ("kernel grouped t16384 dbmajor", _bin_candidates, (qs, db),
+         dict(block_q=128, tile_n=16384, bin_w=128, survivors=2,
+              precision="bf16x3", interpret=False, binning="grouped",
+              grid_order="db_major")),
+        ("kernel grouped t32768 bq256 dbmajor", _bin_candidates, (qs, db),
+         dict(block_q=256, tile_n=32768, bin_w=128, survivors=2,
+              precision="bf16x3", interpret=False, binning="grouped",
+              grid_order="db_major")),
+        ("certified grouped t32768 dbmajor exact", local_certified_candidates,
+         (qs, db), dict(m=128, block_q=128, tile_n=32768,
+                        final_select="exact", interpret=False,
+                        binning="grouped", grid_order="db_major")),
         # non-128-dim configs: multi-chunk scratch accumulation, at the
         # library-default tile (what a bench run with no overrides uses)
         ("kernel grouped gist dim960 t16384", _bin_candidates, (qg, dbg),

@@ -96,6 +96,17 @@ def main():
         ("g_t32768_bq256_x3f",
          dict(binning="grouped", tile_n=32768, block_q=256, survivors=2,
               precision="bf16x3f")),
+        # db-major grid order (round-5 addition): each db tile streams
+        # ONCE per sweep instead of once per query block — the cost
+        # model's biggest kernel term (docs/PERF.md).  Interpret-mode
+        # bitwise-equal to query-major; compiled soundness rides the
+        # same bench gate as every winner.
+        ("g_t16384_dbmajor",
+         dict(binning="grouped", tile_n=16384, block_q=128, survivors=2,
+              grid_order="db_major")),
+        ("g_t32768_bq256_dbmajor",
+         dict(binning="grouped", tile_n=32768, block_q=256, survivors=2,
+              grid_order="db_major")),
     ]
 
     def kw_of(key):
@@ -103,6 +114,7 @@ def main():
         kw.setdefault("block_q", 128)
         kw.setdefault("bin_w", 128)
         kw.setdefault("precision", "bf16x3")
+        kw.setdefault("grid_order", "query_major")
         return kw
 
     kern, e2e = {}, {}
@@ -138,7 +150,8 @@ def main():
         kw = kw_of(winner if winner in dict(variants) else "g_t32768_bq256")
         if winner == "g_t16384_bq256_exact":
             kw = dict(binning="grouped", tile_n=16384, block_q=256,
-                      survivors=2, bin_w=128, precision="bf16x3")
+                      survivors=2, bin_w=128, precision="bf16x3",
+                      grid_order="query_major")
         overrides = {
             "KNN_BENCH_PALLAS_BINNING": kw["binning"],
             "KNN_BENCH_PALLAS_TILE": str(kw["tile_n"]),
@@ -146,6 +159,7 @@ def main():
             "KNN_BENCH_PALLAS_BLOCK_Q": str(kw["block_q"]),
             "KNN_BENCH_PALLAS_BIN_W": str(kw["bin_w"]),
             "KNN_BENCH_PALLAS_PRECISION": kw["precision"],
+            "KNN_BENCH_PALLAS_GRID": kw["grid_order"],
             "KNN_BENCH_PALLAS_FINAL": "exact",
         }
         log(f"new e2e winner {winner} ({ok[winner]} ms < {R5A_E2E_BEST}); "

@@ -74,9 +74,32 @@ def test_dim_chunking_matches_unchunked_scores(rng):
         assert set(t.tolist()) <= set(c.tolist())
 
 
+def test_db_major_grid_bitwise_equal_query_major(rng):
+    # the grid-order change touches ONLY iteration order: every output
+    # (candidates, indices, bounds) must be bitwise-identical, across
+    # single- and multi-chunk dims and uneven tile counts
+    from knn_tpu.ops.pallas_knn import _bin_candidates
+
+    for dim in (24, 300):
+        db = rng.normal(size=(3 * BIN_W + 40, dim)).astype(np.float32) * 10
+        queries = rng.normal(size=(11, dim)).astype(np.float32) * 10
+        outs = {}
+        for go in ("query_major", "db_major"):
+            outs[go] = _bin_candidates(
+                jnp.asarray(queries), jnp.asarray(db), block_q=8,
+                tile_n=2 * BIN_W, bin_w=BIN_W, survivors=2,
+                precision="bf16x3", interpret=True, binning="grouped",
+                grid_order=go)
+        for a, b in zip(outs["query_major"], outs["db_major"]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 @pytest.mark.parametrize("precision", ["highest", "bf16x3", "bf16x3f"])
-@pytest.mark.parametrize("binning", ["grouped", "lane"])
-def test_exclusion_bound_is_sound(rng, precision, binning):
+@pytest.mark.parametrize("binning,grid_order", [
+    ("grouped", "query_major"), ("lane", "query_major"),
+    ("grouped", "db_major"),
+])
+def test_exclusion_bound_is_sound(rng, precision, binning, grid_order):
     # THE property the one-pass certificate rests on: every db point
     # outside the candidate set must have kernel-space score >= lb
     # (within the precision mode's tolerance), and the returned d32 must
@@ -87,7 +110,7 @@ def test_exclusion_bound_is_sound(rng, precision, binning):
     d32, idx, lb = local_certified_candidates(
         jnp.asarray(queries), jnp.asarray(db), m=m, block_q=8,
         tile_n=2 * BIN_W, precision=precision, interpret=True,
-        binning=binning,
+        binning=binning, grid_order=grid_order,
     )
     d32 = np.asarray(d32)[:7]
     idx, lb = np.asarray(idx)[:7], np.asarray(lb)[:7]
