@@ -50,6 +50,7 @@ _EXPORTS = {
     "KNNRegressor": "knn_tpu.models.regressor",
     "RadiusNeighborsClassifier": "knn_tpu.models.radius",
     "RadiusNeighborsRegressor": "knn_tpu.models.radius",
+    "NearestNeighbors": "knn_tpu.models.neighbors",
     "radius_search": "knn_tpu.ops.radius",
     "count_within": "knn_tpu.ops.radius",
     "JobConfig": "knn_tpu.utils.config",

@@ -94,16 +94,14 @@ class _RadiusNeighborsBase:
 
     def _checked_neighbors(self, Q):
         """radius_neighbors + the strict truncation guard, as numpy."""
+        from knn_tpu.ops.radius import check_truncation
+
         d, idx, counts = self.radius_neighbors(Q)
         counts = np.asarray(counts)
-        if self.strict and (counts > self.max_neighbors).any():
-            raise ValueError(
-                f"{int((counts > self.max_neighbors).sum())} queries have "
-                f"more than max_neighbors={self.max_neighbors} in-radius "
-                f"neighbors (max {int(counts.max())}); raise max_neighbors, "
-                f"shrink the radius, or pass strict=False to aggregate the "
-                f"nearest {self.max_neighbors}"
-            )
+        if self.strict:
+            check_truncation(
+                counts, self.max_neighbors,
+                f"aggregate the nearest {self.max_neighbors}")
         return np.asarray(d), np.asarray(idx), counts
 
 

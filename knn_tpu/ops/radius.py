@@ -30,6 +30,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from knn_tpu.ops.distance import pairwise_distance
@@ -108,6 +109,21 @@ def count_within(
         (jnp.arange(n_tiles, dtype=jnp.int32), tiles),
     )
     return counts
+
+
+def check_truncation(counts, max_neighbors: int, action_hint: str) -> None:
+    """Raise when any query's in-radius set exceeds ``max_neighbors`` —
+    the ONE home of the strict-mode truncation contract, shared by the
+    radius estimators and the graph exports."""
+    counts = np.asarray(counts)
+    over = counts > max_neighbors
+    if over.any():
+        raise ValueError(
+            f"{int(over.sum())} queries have more than "
+            f"max_neighbors={max_neighbors} in-radius neighbors "
+            f"(max {int(counts.max())}); raise max_neighbors, shrink the "
+            f"radius, or pass strict=False to {action_hint}"
+        )
 
 
 def radius_search(
