@@ -125,7 +125,7 @@ for port in (8083, 8082):
         s.close()
 sys.exit(1)  # every port refused: no tunnel
 EOF
-        timeout 180 python - <<'EOF' >/dev/null 2>&1
+        timeout --kill-after=30 180 python - <<'EOF' >/dev/null 2>&1
 import jax
 assert jax.devices()[0].platform != "cpu"
 EOF
