@@ -452,6 +452,46 @@ def main() -> None:
         CPU_QUERIES = cap("KNN_BENCH_CPU_QUERIES", CPU_QUERIES, 32)
         if cpu_shrunk:
             _vlog(f"cpu backend: shrunk to N={N} NQ={NQ} RUNS={RUNS}")
+
+    def curated_tpu_reference():
+        """When this run is a CPU FALLBACK (relay down at bench time),
+        point the emitted line at the round's curated TPU measurement
+        for the same config — the fallback line then carries the real
+        hardware evidence (clearly labeled as a pointer, not a
+        measurement of this run) instead of only a shrunken CPU number.
+        Reads the newest TPU_BENCH_r*.jsonl next to this script."""
+        import glob
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        files = sorted(glob.glob(os.path.join(here, "TPU_BENCH_r*.jsonl")))
+        if not files:
+            return None
+        want_prefix = f"knn_qps_{CONFIG}_"
+        try:
+            lines = open(files[-1]).read().splitlines()
+        except OSError:
+            return None
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # skip the one bad/blank line, not the lookup
+            if (str(rec.get("metric", "")).startswith(want_prefix)
+                    # only a REAL hardware line may stand in as TPU
+                    # evidence — a curated file can itself contain a
+                    # CPU-fallback record for a config
+                    and rec.get("backend") == "tpu"
+                    and not rec.get("cpu_fallback_shrunk")):
+                return {
+                    "source": os.path.basename(files[-1]),
+                    "metric": rec.get("metric"),
+                    "value": rec.get("value"),
+                    "device_phase_qps": rec.get("device_phase_qps"),
+                    "pallas_gate_ok": rec.get("pallas_gate_ok"),
+                    "recall_at_k": rec.get("recall_at_k"),
+                    "backend": rec.get("backend"),
+                }
+        return None
     # peak FLOPs for MFU: env override > known device kind > None (a v5e
     # default on an unknown/CPU backend would yield a meaningless MFU)
     if "KNN_BENCH_PEAK_FLOPS" in os.environ:
@@ -890,6 +930,12 @@ def main() -> None:
     # TPU, binds the end-to-end number
     dev_qps = (results.get("certified_pallas", {})
                .get("phase_breakdown", {}).get("device_qps"))
+    # the pointer applies to any relay-down FALLBACK run (backend fell
+    # to cpu without being asked for), shrunken or not — explicit env
+    # size overrides must not lose the hardware evidence
+    fell_back = (backend == "cpu"
+                 and os.environ.get("KNN_BENCH_PLATFORM") != "cpu")
+    curated_ref = curated_tpu_reference() if fell_back else None
     _emit({
         "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
         "value": qps,
@@ -918,6 +964,10 @@ def main() -> None:
         # lands inside a driver timeout — NOT comparable to TPU lines
         # (the metric name carries the actual n/dim/k)
         **({"cpu_fallback_shrunk": True} if cpu_shrunk else {}),
+        # the round's curated hardware line for this config (a POINTER,
+        # not a measurement of this run): a relay-down fallback line
+        # still carries the real TPU evidence
+        **({"curated_tpu_line": curated_ref} if curated_ref else {}),
         # the winning mode's actual batch: the pallas path runs ONE
         # full-size batch (sweep_certified passes batch_size=None)
         "batch": NQ if best == "certified_pallas" else BATCH,
