@@ -126,6 +126,11 @@ try:
     #: re-selects the true top-K among them (ops.refine); the certificate
     #: (ops.certified) then proves no true neighbor was missed, or falls back.
     MARGIN = _env_int("KNN_BENCH_MARGIN", 28)
+    #: ``serving`` mode trace: request count, in-flight dispatch-ahead
+    #: window, and the bucket ladder's floor (ladder tops out at BATCH)
+    SERVING_REQUESTS = _env_int("KNN_BENCH_SERVING_REQUESTS", 48)
+    SERVING_DEPTH = _env_int("KNN_BENCH_SERVING_DEPTH", 2)
+    SERVING_MIN_BUCKET = _env_opt_int("KNN_BENCH_SERVING_MIN_BUCKET")
 except Exception as _e:  # bad env: the one-JSON-line contract still holds
     print(json.dumps({
         "metric": "knn_qps_config", "value": None, "unit": "queries/s",
@@ -540,12 +545,16 @@ def main() -> None:
     # 2,168, TPU_BENCH_r04.jsonl) and tunnel minutes are the scarcest
     # resource; it remains fully covered on CPU (tests + this default) and
     # reachable anywhere via KNN_BENCH_MODES.
+    # ``serving`` rides along by default: it reuses the placement and its
+    # trace is tiny next to the timed sweeps, but it is the only line that
+    # measures the variable-batch-size traffic pattern (sustained q/s +
+    # tail latency through the bucketed engine)
     if not certifiable:
-        default_modes = "exact"
+        default_modes = "exact,serving"
     elif backend == "cpu":
-        default_modes = "exact,certified_approx,certified_pallas"
+        default_modes = "exact,certified_approx,certified_pallas,serving"
     else:
-        default_modes = "exact,certified_pallas"
+        default_modes = "exact,certified_pallas,serving"
     modes = os.environ.get("KNN_BENCH_MODES", default_modes).split(",")
 
     # ONE device placement of the (padded) database, shared by every mode:
@@ -616,6 +625,45 @@ def main() -> None:
             )
             return i, st
         return run
+
+    def sweep_serving():
+        """Variable-batch-size trace through the shape-bucketed serving
+        engine (knn_tpu.serving): log-uniform request sizes in [1, BATCH]
+        replayed with a bounded dispatch-ahead window.  Reports SUSTAINED
+        q/s and p50/p95/p99 request latency — the traffic-pattern number
+        the single-shot sweeps above cannot measure — plus the compile
+        accounting that proves the bucket ladder bounded the XLA compile
+        count."""
+        from knn_tpu.serving.engine import ServingEngine
+
+        min_bucket = SERVING_MIN_BUCKET or max(1, BATCH // 32)
+        eng = ServingEngine(prog, min_bucket=min_bucket, max_bucket=BATCH)
+        t0 = time.perf_counter()
+        eng.warmup()
+        warm_s = time.perf_counter() - t0
+        t_rng = np.random.default_rng(42)
+        sizes = np.exp(
+            t_rng.uniform(0.0, np.log(BATCH), size=SERVING_REQUESTS)
+        ).astype(np.int64).clip(1, BATCH)
+        reqs = []
+        for s in sizes:
+            lo = int(t_rng.integers(0, max(1, NQ - int(s))))
+            reqs.append(queries[lo : lo + int(s)])
+        _, report = eng.replay(reqs, depth=SERVING_DEPTH)
+        return {
+            "sustained_qps": report["sustained_qps"],
+            "latency_ms": report["latency_ms"],
+            "trace_requests": report["requests"],
+            "trace_queries": report["total_queries"],
+            "trace_wall_s": report["wall_s"],
+            "dispatch_depth": SERVING_DEPTH,
+            "warmup_s": round(warm_s, 4),
+            "bucket_ladder": report["buckets"],
+            "compile_count": report["compile_count"],
+            "executables": report["executables"],
+            "per_bucket_dispatches": report["per_bucket_dispatches"],
+            "donate_queries": report["donate_queries"],
+        }
 
     sweeps = {
         "exact": sweep_exact,
@@ -814,6 +862,16 @@ def main() -> None:
     results = {}
     for mode in modes:
         entry = {}
+        if mode == "serving":
+            # trace replay, not a fixed-shape timed sweep: its entry
+            # carries sustained_qps + latency percentiles instead of
+            # qps_mean, and never competes for the headline number
+            try:
+                entry = sweep_serving()
+            except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+                entry = {"error": f"{type(e).__name__}: {e}"}
+            results[mode] = entry
+            continue
         try:
             fn = sweeps[mode]
             _vlog(f"mode {mode}: recall check + warm ...")
@@ -943,6 +1001,13 @@ def main() -> None:
         "vs_baseline": round(qps / cpu_qps_r, 2) if cpu_qps_r else None,
         "mode": best,
         "device_phase_qps": dev_qps,
+        # the variable-batch-size traffic numbers (serving mode): hoisted
+        # so the sustained rate + tail latency are readable without
+        # digging into the selectors table
+        **({
+            "serving_sustained_qps": results["serving"].get("sustained_qps"),
+            "serving_latency_ms": results["serving"].get("latency_ms"),
+        } if results.get("serving", {}).get("sustained_qps") else {}),
         **(gate or {}),
         "recall_at_k": results[best].get("recall_at_k"),
         **recall_flag,

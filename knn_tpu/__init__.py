@@ -67,6 +67,9 @@ _EXPORTS = {
     "streaming_knn": "knn_tpu.streaming",
     "StreamingCertifiedSearch": "knn_tpu.streaming",
     "streaming_certified_knn": "knn_tpu.streaming",
+    "ServingEngine": "knn_tpu.serving",
+    "QueryQueue": "knn_tpu.serving",
+    "bucket_ladder": "knn_tpu.serving",
 }
 
 __all__ = sorted(_EXPORTS) + ["__version__"]

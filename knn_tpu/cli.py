@@ -52,6 +52,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--selector", default="approx", choices=("exact", "approx", "pallas"),
         help="local-shard selector for --mode certified",
     )
+    p.add_argument(
+        "--serve-buckets", default=None, metavar="SPEC",
+        help="shape-bucketed serving: 'auto' or a comma list like "
+        "'64,128,256' — query chunks pad up a geometric bucket ladder of "
+        "precompiled executables (warmup at startup, at most one XLA "
+        "compile per bucket for ANY traffic pattern); per-bucket compile "
+        "counts and latency percentiles land in the JSON metrics",
+    )
+    p.add_argument(
+        "--max-wait-ms", type=float, default=2.0,
+        help="micro-batching deadline for CONCURRENT serving "
+        "(knn_tpu.serving.QueryQueue): max time a request waits to be "
+        "coalesced into a bigger bucket.  The sequential batch job this "
+        "CLI runs has no concurrent callers, so here the value is only "
+        "echoed into the serving metrics for downstream queue deployments",
+    )
     p.add_argument("--num-threads", type=int, default=0, help="native backend threads (0 = all cores)")
     p.add_argument("--metrics-json", default=None, help="write structured run metrics to this path")
     p.add_argument(
@@ -86,6 +102,8 @@ def args_to_config(args: argparse.Namespace) -> JobConfig:
         compute_dtype=args.compute_dtype,
         mode=args.mode,
         selector=args.selector,
+        serve_buckets=args.serve_buckets,
+        max_wait_ms=args.max_wait_ms,
         num_threads=args.num_threads,
     )
 
@@ -93,12 +111,11 @@ def args_to_config(args: argparse.Namespace) -> JobConfig:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cpu_devices:
-        import jax
-
         # Must precede backend initialization; env vars are too late when a
         # sitecustomize hook has already registered an accelerator plugin.
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from knn_tpu.utils.compat import request_cpu_devices
+
+        request_cpu_devices(args.cpu_devices)
     from knn_tpu.pipeline import run_job  # deferred: JAX import is heavy
 
     result = run_job(args_to_config(args))

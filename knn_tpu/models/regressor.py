@@ -38,10 +38,12 @@ def knn_regress(
     dists, idx = knn_search_tiled(
         queries, train, k, metric, train_tile=train_tile, compute_dtype=compute_dtype
     )
-    return _weighted_targets(dists, train_targets[idx], weights, metric)
+    return _weighted_targets(dists, train_targets[idx], weights, metric,
+                             queries=queries)
 
 
-def _weighted_targets(dists, targets, weights: str, metric: str = "l2"):
+def _weighted_targets(dists, targets, weights: str, metric: str = "l2",
+                      queries=None):
     """Reduce [Q, k] neighbor targets to predictions — the one place the
     uniform/inverse-distance weighting lives (single-device and meshed
     paths share it).
@@ -49,12 +51,26 @@ def _weighted_targets(dists, targets, weights: str, metric: str = "l2"):
     ``weights="distance"`` is conventional 1/d weighting: the search
     returns SQUARED L2 for ranking speed (the monotone sqrt is dropped,
     knn_mpi.cpp:48), so the l2 metrics sqrt here first — weighting by
-    squared distance would silently over-discount far neighbors."""
+    squared distance would silently over-discount far neighbors.
+
+    Exact-hit robustness: the expanded-square distance of a query to its
+    own database row cancels to ~eps * ||q||^2 instead of exactly 0, and
+    how much of that noise survives depends on the backend's matmul.
+    When ``queries`` is provided (l2 family), squared distances within
+    the cancellation band ``64 eps ||q||^2`` snap to zero, so exact
+    duplicates dominate the weighting on every backend (the sklearn
+    zero-distance convention) instead of receiving a finite
+    noise-inflated distance."""
     targets = targets.astype(jnp.float32)  # [Q, k] or [Q, k, out]
     if weights == "uniform":
         return jnp.mean(targets, axis=1)
     if weights == "distance":
         if metric.lower() in L2_FAMILY:
+            if queries is not None:
+                q32 = jnp.asarray(queries).astype(jnp.float32)
+                q_norm = jnp.sum(q32 * q32, axis=-1, keepdims=True)
+                band = 64.0 * jnp.float32(jnp.finfo(jnp.float32).eps) * q_norm
+                dists = jnp.where(dists <= band, 0.0, dists)
             dists = jnp.sqrt(jnp.maximum(dists, 0.0))
         w = 1.0 / jnp.maximum(dists, DIST_FLOOR)  # [Q, k]
         w = w / jnp.sum(w, axis=1, keepdims=True)
@@ -122,7 +138,8 @@ class KNNRegressor:
         if self._program is not None:
             dists, idx = self._program.search(jnp.asarray(Q))
             return _weighted_targets(
-                dists, self._targets[idx], self.weights, self.metric
+                dists, self._targets[idx], self.weights, self.metric,
+                queries=Q,
             )
         return knn_regress(
             self._train,

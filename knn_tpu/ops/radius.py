@@ -41,6 +41,16 @@ from knn_tpu.ops.topk import knn_search_tiled
 SENTINEL_IDX = -1
 
 
+def _dispatch_metric(metric: str) -> str:
+    """Canonical dispatch name for a radius-API metric.  ``'cityblock'``
+    is accepted by :func:`radius_threshold` (eager validation) but not by
+    ops.distance.pairwise_distance, so it is normalized to ``'l1'`` HERE,
+    before any dispatch — validation and execution must agree on the
+    metric vocabulary (ADVICE r5)."""
+    m = metric.lower()
+    return "l1" if m == "cityblock" else m
+
+
 def radius_threshold(radius: float, metric: str) -> float:
     """The ranking-space threshold for a user-units ``radius``."""
     m = metric.lower()
@@ -85,6 +95,7 @@ def count_within(
     query norm, strict ``<``) is PINNED by the certificate's f32 error
     model (certification_tolerance) and must not drift, while this pass
     is metric-general with ``<=`` and follows pairwise_distance."""
+    metric = _dispatch_metric(metric)
     n = db.shape[0]
     tile = min(tile, n)
     limit = n if n_valid is None else jnp.minimum(n, n_valid)
@@ -146,7 +157,8 @@ def radius_search(
     silent.  Distances are in ranking space (squared for the l2 family;
     callers wanting Euclidean values apply ops.distance.metric_values).
     """
-    thr = radius_threshold(radius, metric)
+    thr = radius_threshold(radius, metric)  # eager validation (aliases ok)
+    metric = _dispatch_metric(metric)  # execution vocabulary
     m = min(int(max_neighbors), db.shape[0])
     if m < 1:
         raise ValueError(f"max_neighbors must be >= 1, got {max_neighbors}")

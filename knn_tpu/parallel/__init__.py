@@ -31,6 +31,7 @@ from knn_tpu.parallel.collectives import (
     allreduce_min,
     allreduce_max,
     barrier,
+    shard_map_compat,
 )
 from knn_tpu.parallel.sharded import (
     ShardedKNN,
@@ -52,6 +53,7 @@ __all__ = [
     "allreduce_min",
     "allreduce_max",
     "barrier",
+    "shard_map_compat",
     "ShardedKNN",
     "sharded_knn",
     "sharded_knn_predict",

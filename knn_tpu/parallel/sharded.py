@@ -30,6 +30,7 @@ The reference's distributed min-max normalize (knn_mpi.cpp:229-306) maps to
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -47,6 +48,7 @@ from knn_tpu.parallel.collectives import (
     gather,
     replicate,
     shard,
+    shard_map_compat,
 )
 from knn_tpu.parallel.mesh import DB_AXIS, QUERY_AXIS, pad_to_multiple
 
@@ -175,6 +177,7 @@ def _knn_program(
     compute_dtype,
     selector: str = "exact",
     recall_target: Optional[float] = None,
+    donate: bool = False,
 ):
     db_shards = mesh.shape[DB_AXIS]
 
@@ -185,13 +188,16 @@ def _knn_program(
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             spmd,
             mesh=mesh,
             in_specs=(P(QUERY_AXIS), P(DB_AXIS)),
             out_specs=(P(QUERY_AXIS), P(QUERY_AXIS)),
             check_vma=False,  # merged output is replicated along db by construction
-        )
+        ),
+        # the serving engine donates its per-request query placement so the
+        # device buffer recycles instead of accumulating across a stream
+        donate_argnums=(0,) if donate else (),
     )
 
 
@@ -415,6 +421,15 @@ class ShardedKNN:
             None if compute_dtype is None else jnp.dtype(compute_dtype).name
         )
         self._tp = shard(tp, mesh, DB_AXIS)  # the reference's Scatter, once
+        #: (k, placed query rows) -> dispatch count: every distinct pair is
+        #: one traced/compiled XLA program shape (compile_cache_stats)
+        self._dispatch_shapes: dict = {}
+        #: lazily built serving engines, keyed by ladder spec
+        #: (buckets, min_bucket, max_bucket) — search_bucketed; the lock
+        #: keeps concurrent cold calls from double-building an engine
+        #: (each build AOT-compiles executables — seconds on hardware)
+        self._serving_engines: dict = {}
+        self._engines_lock = threading.Lock()
         self._labels = None
         self.num_classes = num_classes
         if labels is not None:
@@ -456,12 +471,83 @@ class ShardedKNN:
             self.mesh, k, self.metric, self.merge, self.n_train,
             self.train_tile, self._dtype_key,
         )
+        shape_key = (k, qp.shape[0])
+        self._dispatch_shapes[shape_key] = (
+            self._dispatch_shapes.get(shape_key, 0) + 1
+        )
         d, i = _retry_transient(lambda: fn(qp, self._tp), "search dispatch")
         if return_sqrt:
             from knn_tpu.ops.distance import metric_values
 
             d = metric_values(d, self.metric)
         return d[:n_q], i[:n_q]
+
+    def search_bucketed(
+        self, queries, *, buckets=None, min_bucket: int = 32,
+        max_bucket: int = 4096, return_sqrt: bool = False,
+    ):
+        """Bucketed exact search (numpy results; same neighbors and
+        tie-break order as :meth:`search`, and bitwise-identical to a
+        :meth:`search` call of the same padded batch — see
+        knn_tpu.serving.engine for the exactness contract): the query
+        batch pads up to a geometric ladder of
+        bucket sizes so ANY traffic pattern of batch shapes hits at most
+        ``len(buckets)`` compiled programs, instead of one compile per
+        distinct batch size.  The engine behind it (built lazily per
+        ladder, reused across calls) AOT-compiles buckets on first use and
+        keeps compile/dispatch/latency accounting — see
+        :meth:`compile_cache_stats` and :mod:`knn_tpu.serving` for the
+        full serving surface (warmup, micro-batching queue, trace
+        replay)."""
+        from knn_tpu.serving.buckets import normalize_ladder
+        from knn_tpu.serving.engine import ServingEngine
+
+        ladder = (
+            None if buckets is None else normalize_ladder(buckets)
+        )
+        # an explicit ladder fully determines the engine — min/max are
+        # ignored then and must not key duplicate engines that would
+        # re-AOT-compile identical executables
+        key = ladder if ladder is not None else (None, min_bucket, max_bucket)
+        with self._engines_lock:
+            engine = self._serving_engines.get(key)
+            if engine is None:
+                # construction is cheap (no compiles happen here); holding
+                # the lock just prevents duplicate engines whose separate
+                # AOT caches would re-compile identical executables
+                engine = ServingEngine(
+                    self, buckets=ladder, min_bucket=min_bucket,
+                    max_bucket=max_bucket,
+                )
+                self._serving_engines[key] = engine
+        return engine.search(queries, return_sqrt=return_sqrt)
+
+    def compile_cache_stats(self) -> dict:
+        """Compile-cache observability for serving: the module program
+        cache (shared across instances — ``_knn_program``'s lru_cache) and
+        THIS placement's dispatched program shapes.  Each distinct
+        ``(k, placed_rows)`` pair is one XLA trace/compile of the search
+        program; a healthy bucketed stream keeps ``distinct_shapes``
+        bounded by its ladder size while ``dispatches`` grows."""
+        info = _knn_program.cache_info()
+        out = {
+            "program_cache": {
+                "hits": info.hits,
+                "misses": info.misses,
+                "size": info.currsize,
+            },
+            "distinct_shapes": len(self._dispatch_shapes),
+            "dispatches": int(sum(self._dispatch_shapes.values())),
+            "shape_counts": {
+                f"k{k}xq{q}": int(c)
+                for (k, q), c in sorted(self._dispatch_shapes.items())
+            },
+        }
+        if self._serving_engines:
+            out["serving_engines"] = [
+                e.stats() for e in self._serving_engines.values()
+            ]
+        return out
 
     def radius_search(self, queries, radius: float, *, max_neighbors: int):
         """All db rows within ``radius`` per query, bounded at
@@ -476,8 +562,12 @@ class ShardedKNN:
         ranking values) and cosine (cosine-distance radius; db rows were
         unit-normalized at placement, queries here; the count runs on
         the unit-vector squared-L2 equivalent ``2 * (1 - sim)``).  L1
-        has no sharded count program and uses the single-device
-        ops.radius path instead.
+        has no sharded count program; when the placement kept a host
+        copy of the train array (any host-array construction) it falls
+        back to the single-device ops.radius path — mask and count share
+        ONE pairwise computation there, so L1 results have the stronger
+        single-program boundary contract — and raises for pre-placed
+        multi-process arrays (no host copy to fall back to).
 
         Boundary contract: the mask (the sharded select's values) and
         the count (the count program) are DIFFERENT XLA programs, so a
@@ -501,6 +591,29 @@ class ShardedKNN:
                 f"its mask/count arithmetics would disagree at the "
                 f"radius boundary"
             )
+        if self.metric in ("l1", "manhattan", "cityblock"):
+            # single-device fallback: no sharded L1 count program exists,
+            # but ops.radius runs mask and count off ONE pairwise pass
+            from knn_tpu.ops.radius import radius_search as _radius_single
+
+            if int(max_neighbors) < 1:
+                raise ValueError(
+                    f"max_neighbors must be >= 1, got {max_neighbors}")
+            try:
+                db_host = self._host_train()
+            except ValueError as e:
+                raise ValueError(
+                    "sharded radius_search has no L1 count program and the "
+                    "single-device fallback needs a host copy of the "
+                    "database; construct ShardedKNN from a host array, or "
+                    "use ops.radius.radius_search directly"
+                ) from e
+            d, i, counts = _radius_single(
+                np.asarray(queries, np.float32), db_host, radius,
+                max_neighbors=min(int(max_neighbors), self.n_train),
+                metric="l1", train_tile=self.train_tile,
+            )
+            return np.asarray(d), np.asarray(i), np.asarray(counts)
         thr = radius_threshold(radius, self.metric)  # ranking space
         if self.metric == "cosine":
             if not self._cosine_unit:
@@ -1046,25 +1159,36 @@ def _predict_program(
     n_train: int,
     train_tile: Optional[int],
     compute_dtype,
+    donate: bool = False,
 ):
     db_shards = mesh.shape[DB_AXIS]
 
-    def spmd(q, t, labels):
-        d, gi = _merged_topk(
+    def spmd(q, t):
+        return _merged_topk(
             q, t, k, metric, merge, n_train, train_tile, compute_dtype, db_shards
         )
+
+    knn = shard_map_compat(
+        spmd,
+        mesh=mesh,
+        in_specs=(P(QUERY_AXIS), P(DB_AXIS)),
+        out_specs=(P(QUERY_AXIS), P(QUERY_AXIS)),
+        check_vma=False,
+    )
+
+    def run(q, t, labels):
+        # the vote runs OUTSIDE the shard_map body (still inside the one
+        # jitted program, still on device): with check_vma/check_rep off,
+        # GSPMD is free to assume a query-spec'd output is replicated
+        # along the db axis, and on 2-D meshes it miscompiled the
+        # in-body vote of the TILED search (every query shard got shard
+        # 0's votes).  On the global [Q, k] index array the partitioner
+        # handles the replicated-label gather + vote natively.
+        _, gi = knn(q, t)
         safe = jnp.minimum(gi, n_train - 1)  # sentinel survives only if n_train < k (raised)
         return majority_vote(labels[safe], num_classes)
 
-    return jax.jit(
-        jax.shard_map(
-            spmd,
-            mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P()),
-            out_specs=P(QUERY_AXIS),
-            check_vma=False,
-        )
-    )
+    return jax.jit(run, donate_argnums=(0,) if donate else ())
 
 
 def sharded_knn_predict(
@@ -1216,7 +1340,7 @@ def _pallas_certified_program(
         return jnp.concatenate(cols, axis=1)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             spmd,
             mesh=mesh,
             in_specs=(P(QUERY_AXIS), P(DB_AXIS), P()),
@@ -1269,7 +1393,7 @@ def _count_program(mesh: Mesh, n_train: int, train_tile: Optional[int]):
         return local
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             spmd,
             mesh=mesh,
             in_specs=(P(QUERY_AXIS), P(DB_AXIS), P(QUERY_AXIS)),
@@ -1293,7 +1417,7 @@ def _minmax_program(mesh: Mesh, n_arrays: int):
         return lo, hi
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             spmd,
             mesh=mesh,
             in_specs=tuple(P((QUERY_AXIS, DB_AXIS)) for _ in range(n_arrays)),

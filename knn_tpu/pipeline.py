@@ -47,6 +47,10 @@ class JobResult:
     #: on the fast path vs fell back to the widened re-select (None outside
     #: certified mode).  Keys: "certified", "fallback_queries".
     certified_stats: Optional[Dict[str, int]] = None
+    #: ``--serve-buckets`` observability (None outside serving mode): the
+    #: bucket ladder, per-bucket compile/dispatch counts, and per-request
+    #: latency percentiles (knn_tpu.serving.ServingEngine.stats).
+    serving_stats: Optional[dict] = None
 
     @property
     def queries_per_sec(self) -> float:
@@ -68,6 +72,8 @@ class JobResult:
         }
         if self.certified_stats is not None:
             out["certified_stats"] = self.certified_stats
+        if self.serving_stats is not None:
+            out["serving"] = self.serving_stats
         return out
 
     def metrics_json(self) -> str:
@@ -138,14 +144,26 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
 
     certified_stats = {"fallback_queries": 0, "certified": 0}
 
+    engine = None
+    if cfg.serve_buckets is not None:
+        # shape-bucketed serving (knn_tpu.serving): variable-size chunks
+        # route through precompiled per-bucket executables — warmup pays
+        # every compile up front, the job loop never compiles again, and
+        # per-bucket compile counts + latency percentiles land in
+        # JobResult.metrics()["serving"]
+        from knn_tpu.serving.buckets import parse_buckets
+        from knn_tpu.serving.engine import ServingEngine
+
+        with timer.phase("serving_warmup"):
+            engine = ServingEngine(program, buckets=parse_buckets(cfg.serve_buckets))
+            engine.warmup(ops=("predict",))
+
     def classify(queries):
         n = queries.shape[0]
         bs = cfg.batch_size or n
         out = []
         for start in range(0, n, bs):
             chunk = queries[start : start + bs]
-            if chunk.shape[0] < bs:  # pad the tail so XLA sees one shape
-                chunk = np.pad(chunk, ((0, bs - chunk.shape[0]), (0, 0)))
             take = min(bs, n - start)
             if cfg.mode == "certified":
                 # real rows only: zero-pad queries would pollute the
@@ -156,7 +174,13 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
                 for key, v in stats.items():  # incl. host_exact_queries
                     certified_stats[key] = certified_stats.get(key, 0) + v
                 out.append(np.asarray(labels_out))
+            elif engine is not None:
+                # the engine pads to its bucket ladder itself; the raw
+                # (possibly short tail) chunk hits a precompiled bucket
+                out.append(engine.predict(chunk))
             else:
+                if chunk.shape[0] < bs:  # pad the tail so XLA sees one shape
+                    chunk = np.pad(chunk, ((0, bs - chunk.shape[0]), (0, 0)))
                 out.append(np.asarray(program.predict(chunk))[:take])
         return np.concatenate(out)
 
@@ -166,9 +190,12 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
             val_pred = classify(val)
     with timer.phase("knn_test"):
         test_pred = classify(test)
+    serving_stats = None
+    if engine is not None:
+        serving_stats = {"max_wait_ms": cfg.max_wait_ms, **engine.stats()}
     return test_pred, val_pred, (
         certified_stats if cfg.mode == "certified" else None
-    )
+    ), serving_stats
 
 
 def _run_native(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
@@ -236,8 +263,9 @@ def run_job(cfg: JobConfig, *, mesh=None) -> JobResult:
             cfg, timer, train, train_labels, test, val, val_labels_real
         )
         certified_stats = None
+        serving_stats = None
     else:
-        test_pred, val_pred, certified_stats = _run_jax(
+        test_pred, val_pred, certified_stats, serving_stats = _run_jax(
             cfg, timer, train, train_labels, test, val, val_labels_real, mesh
         )
 
@@ -259,4 +287,5 @@ def run_job(cfg: JobConfig, *, mesh=None) -> JobResult:
         n_val=0 if val is None else val.shape[0],
         config=cfg,
         certified_stats=certified_stats,
+        serving_stats=serving_stats,
     )

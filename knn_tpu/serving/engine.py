@@ -1,0 +1,381 @@
+"""The serving engine: precompiled shape-bucketed executables over a placed
+:class:`~knn_tpu.parallel.sharded.ShardedKNN`, with async dispatch-ahead.
+
+Three mechanisms turn the batch library into a throughput engine:
+
+- **Shape bucketing** (serving.buckets): each request pads up to the
+  smallest ladder bucket, so any traffic pattern hits O(log) compiled
+  programs.  Pad rows are whole zero queries whose outputs are sliced
+  away on host — the distance matrix is row-separable and the top-k runs
+  per row, so padding is ARITHMETIC-TRANSPARENT: bucketed results are
+  bitwise identical to a direct ``search()`` call of the same placed
+  batch (asserted in tests/test_serving.py).  Against the *unpadded*
+  direct call the guarantee is backend-dependent, exactly as it already
+  is between two direct calls of different batch sizes: the TPU MXU's
+  K-dim reduction order is batch-shape invariant (bitwise there), while
+  CPU XLA's gemm strategy varies with batch shape in the last float
+  bits — neighbor IDENTITY and lexicographic tie-break order are
+  preserved either way (same pad-and-slice contract
+  ``ShardedKNN._place_queries`` already relies on for mesh
+  divisibility).
+- **Precompiled executables**: :meth:`ServingEngine.warmup` AOT-compiles
+  every bucket up front via ``jit(...).lower(...).compile()`` — no
+  request ever stalls on an inline XLA compile.  Compiles are counted
+  per bucket; a replayed trace of any batch-size mix compiles at most
+  ``len(buckets)`` programs (asserted in tests/test_serving.py).
+- **Async dispatch-ahead**: :meth:`submit` returns immediately with a
+  :class:`PendingSearch` handle — JAX dispatch is asynchronous, so the
+  host can pad/place/dispatch request N+1 while the device executes
+  request N (double-buffered via :meth:`replay`'s bounded in-flight
+  window).  Query placements are DONATED to the program on non-CPU
+  backends, so each bucket's input buffer is recycled instead of
+  accumulating.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from knn_tpu.serving.buckets import (
+    DEFAULT_MAX_BUCKET,
+    DEFAULT_MIN_BUCKET,
+    bucket_for,
+    bucket_ladder,
+    normalize_ladder,
+    split_sizes,
+)
+
+#: operations the engine can serve; each maps to one cached program family
+OPS = ("search", "predict")
+
+
+def latency_summary(samples_s: Sequence[float]) -> Optional[Dict[str, float]]:
+    """p50/p95/p99/mean (milliseconds) of per-request wall latencies —
+    the engine feeds its bounded recent-request window (``count`` is the
+    window's fill, not the lifetime request total; see stats())."""
+    if not samples_s:
+        return None
+    arr = np.asarray(samples_s, dtype=np.float64) * 1e3
+    return {
+        "p50": round(float(np.percentile(arr, 50)), 3),
+        "p95": round(float(np.percentile(arr, 95)), 3),
+        "p99": round(float(np.percentile(arr, 99)), 3),
+        "mean": round(float(arr.mean()), 3),
+        "max": round(float(arr.max()), 3),
+        "count": int(arr.size),
+    }
+
+
+class PendingSearch:
+    """An in-flight bucketed request: device work was dispatched
+    asynchronously; :meth:`result` blocks on the transfer, slices the pad
+    rows away, and records the request's wall latency."""
+
+    def __init__(self, engine: "ServingEngine", op: str, chunks, n: int, t0: float):
+        self._engine = engine
+        self._op = op
+        self._chunks = chunks  # [(device outputs, redo, rows)]
+        self._n = n
+        self._t0 = t0
+        self._done = False
+
+    def result(self):
+        from knn_tpu.parallel.sharded import _fetch_or_redispatch
+
+        parts = []
+        for out, redo, rows in self._chunks:
+            if self._op == "search":
+                d = _fetch_or_redispatch(
+                    out[0], lambda r=redo: r()[0], "serving fetch (d)")
+                i = _fetch_or_redispatch(
+                    out[1], lambda r=redo: r()[1], "serving fetch (i)")
+                parts.append((d[:rows], i[:rows]))
+            else:
+                lbl = _fetch_or_redispatch(out, redo, "serving fetch (labels)")
+                parts.append(lbl[:rows])
+        if self._op == "search":
+            d = np.concatenate([p[0] for p in parts])[: self._n]
+            i = np.concatenate([p[1] for p in parts])[: self._n]
+            res = (d, i)
+        else:
+            res = np.concatenate(parts)[: self._n]
+        if not self._done:  # latency is per request, not per .result() call
+            self._done = True
+            self._engine._record_latency(time.perf_counter() - self._t0)
+        return res
+
+
+class ServingEngine:
+    """Shape-bucketed query-serving frontend over a placed ``ShardedKNN``.
+
+    Construction is cheap (no compiles); call :meth:`warmup` at startup to
+    AOT-compile every bucket, or let the first request of each bucket pay
+    its compile once.  All compile/dispatch accounting is exposed via
+    :meth:`stats`.
+
+    ``donate_queries=None`` donates the query placement to the program on
+    non-CPU backends (buffer reuse; CPU XLA rejects the donation with a
+    warning, so it defaults off there).
+    """
+
+    def __init__(
+        self,
+        program,
+        *,
+        buckets: Optional[Sequence[int]] = None,
+        min_bucket: int = DEFAULT_MIN_BUCKET,
+        max_bucket: int = DEFAULT_MAX_BUCKET,
+        k: Optional[int] = None,
+        donate_queries: Optional[bool] = None,
+        aot: bool = True,
+        latency_window: int = 4096,
+    ):
+        import jax
+
+        self.program = program
+        self.k = program.k if k is None else int(k)
+        self.buckets = (
+            bucket_ladder(min_bucket, max_bucket) if buckets is None
+            else normalize_ladder(buckets)
+        )
+        if donate_queries is None:
+            donate_queries = jax.default_backend() != "cpu"
+        self.donate_queries = bool(donate_queries)
+        self._aot = bool(aot)
+        self._dim = int(program._tp.shape[1])
+        self._lock = threading.Lock()
+        self._execs: Dict[Tuple[str, int], object] = {}
+        #: per-key in-flight compile events (see _executable)
+        self._compiling: Dict[Tuple[str, int], threading.Event] = {}
+        self._compiles: Counter = Counter()  # bucket -> compile count
+        self._dispatches: Counter = Counter()  # bucket -> dispatch count
+        self._requests = 0
+        #: bounded sample window: a long-running service must not grow a
+        #: per-request list forever, and stats() percentiles over the
+        #: recent window are the operationally useful number anyway
+        self._latencies_s: deque = deque(maxlen=int(latency_window))
+
+    # -- compile cache -----------------------------------------------------
+    def _jit_fn(self, op: str):
+        from knn_tpu.parallel.sharded import _knn_program, _predict_program
+
+        p = self.program
+        if op == "search":
+            return _knn_program(
+                p.mesh, self.k, p.metric, p.merge, p.n_train, p.train_tile,
+                p._dtype_key, donate=self.donate_queries,
+            )
+        if p._labels is None:
+            raise RuntimeError(
+                "ServingEngine op='predict' needs a ShardedKNN built with "
+                "labels")
+        return _predict_program(
+            p.mesh, self.k, p.num_classes, p.metric, p.merge, p.n_train,
+            p.train_tile, p._dtype_key, donate=self.donate_queries,
+        )
+
+    def _placed_rows(self, bucket: int) -> int:
+        from knn_tpu.parallel.mesh import QUERY_AXIS
+
+        qs = self.program.mesh.shape[QUERY_AXIS]
+        return -(-bucket // qs) * qs
+
+    def _tail_args(self, op: str) -> tuple:
+        p = self.program
+        return (p._tp,) if op == "search" else (p._tp, p._labels)
+
+    def _executable(self, op: str, bucket: int):
+        """The compiled executable for ``(op, bucket)``; compiles AOT on
+        first use (``lower().compile()`` — no example batch is executed).
+        Distinct buckets below the mesh's query-shard count share one
+        placed shape and therefore one executable.
+
+        The engine lock is NEVER held across the XLA compile (seconds on
+        real hardware): a cold bucket's compile must not freeze
+        concurrent dispatches to warm buckets, stats(), or latency
+        recording.  Concurrent first requests to the same key wait on a
+        per-key event instead."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from knn_tpu.parallel.mesh import QUERY_AXIS
+
+        key = (op, self._placed_rows(bucket))
+        while True:
+            with self._lock:
+                ex = self._execs.get(key)
+                if ex is not None:
+                    return ex
+                ev = self._compiling.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._compiling[key] = ev
+                    break  # this thread owns the compile
+            ev.wait()  # another thread is compiling this key; re-check
+        try:
+            fn = self._jit_fn(op)
+            if self._aot:
+                q_spec = jax.ShapeDtypeStruct(
+                    (key[1], self._dim), np.float32,
+                    sharding=NamedSharding(self.program.mesh, P(QUERY_AXIS)),
+                )
+                try:
+                    ex = fn.lower(q_spec, *self._tail_args(op)).compile()
+                except Exception:
+                    # AOT API drift: fall back to the plain jitted callable
+                    # (still exactly one compile per placed shape, paid on
+                    # the first dispatch instead of here)
+                    ex = fn
+            else:
+                ex = fn
+            with self._lock:
+                self._execs[key] = ex
+                self._compiles[bucket] += 1
+            return ex
+        finally:
+            # waiters re-check _execs; on a raised _jit_fn error they
+            # find the key absent and retry (re-raising for themselves)
+            with self._lock:
+                del self._compiling[key]
+            ev.set()
+
+    def warmup(self, ops: Sequence[str] = ("search",)) -> Dict[str, int]:
+        """AOT-compile every bucket for each requested op so no live
+        request ever pays an inline compile.  Returns per-op executable
+        counts (ladder rungs sharing a placed shape share an executable)."""
+        counts = {}
+        for op in ops:
+            if op not in OPS:
+                raise ValueError(f"unknown op {op!r}; expected one of {OPS}")
+            for b in self.buckets:
+                self._executable(op, b)
+            with self._lock:  # concurrent cold compiles mutate _execs
+                keys = list(self._execs)
+            counts[op] = len({k for k in keys if k[0] == op})
+        return counts
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_chunk(self, op: str, chunk: np.ndarray):
+        """Pad one <=max_bucket chunk to its bucket and dispatch (async).
+        Returns (device outputs, redo closure, real row count)."""
+        from knn_tpu.parallel.sharded import _retry_transient
+
+        n = chunk.shape[0]
+        bucket = bucket_for(self.buckets, n)
+        assert bucket is not None  # callers split oversize requests first
+        if n < bucket:
+            padded = np.zeros((bucket, self._dim), dtype=np.float32)
+            padded[:n] = chunk
+        else:
+            padded = chunk
+
+        def go():
+            # re-place on every attempt: with donation the previous
+            # placement's buffer is consumed by the failed dispatch
+            qp, _ = self.program._place_queries(padded)
+            return self._executable(op, bucket)(qp, *self._tail_args(op))
+
+        out = _retry_transient(go, "serving dispatch")
+        with self._lock:
+            self._dispatches[bucket] += 1
+        return out, go, n
+
+    def submit(self, queries, *, op: str = "search") -> PendingSearch:
+        """Dispatch ``queries`` (async) and return a handle; oversize
+        requests split into max-bucket chunks, each dispatched back to
+        back so the device pipeline stays full."""
+        if op not in OPS:
+            raise ValueError(f"unknown op {op!r}; expected one of {OPS}")
+        q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
+        if q.ndim != 2 or q.shape[1] != self._dim:
+            raise ValueError(
+                f"queries shape {q.shape} incompatible with database dim "
+                f"{self._dim}")
+        t0 = time.perf_counter()
+        chunks = []
+        lo = 0
+        for size in split_sizes(q.shape[0], self.buckets[-1]):
+            chunks.append(self._dispatch_chunk(op, q[lo : lo + size]))
+            lo += size
+        with self._lock:
+            self._requests += 1
+        return PendingSearch(self, op, chunks, q.shape[0], t0)
+
+    def search(self, queries, *, return_sqrt: bool = False):
+        """Bucketed exact search: (distances [Q, k], indices [Q, k]) as
+        numpy arrays, bitwise identical to ``ShardedKNN.search``."""
+        d, i = self.submit(queries, op="search").result()
+        if return_sqrt:
+            from knn_tpu.ops.distance import metric_values
+
+            d = np.asarray(metric_values(d, self.program.metric))
+        return d, i
+
+    def predict(self, queries) -> np.ndarray:
+        """Bucketed classification: labels [Q] int32 (majority vote on
+        device, same program family as ``ShardedKNN.predict``)."""
+        return self.submit(queries, op="predict").result()
+
+    # -- trace replay ------------------------------------------------------
+    def replay(self, requests: Sequence[np.ndarray], *, depth: int = 2):
+        """Replay a request trace with at most ``depth`` requests in
+        flight: request N+1 is padded/placed/dispatched while request N
+        executes (the double-buffer that overlaps host staging with
+        device compute).  Returns ``(results, report)`` where ``report``
+        carries sustained q/s and the latency percentiles."""
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        results: List[object] = [None] * len(requests)
+        pending: List[Tuple[int, PendingSearch]] = []
+        total_rows = 0
+        t0 = time.perf_counter()
+        for idx, q in enumerate(requests):
+            # drain BEFORE submitting so at most ``depth`` requests are
+            # ever in flight, the new one included — while the oldest's
+            # result() blocks, the depth-1 behind it keep the device busy
+            while len(pending) >= depth:
+                j, h = pending.pop(0)
+                results[j] = h.result()
+            total_rows += int(np.shape(q)[0])
+            pending.append((idx, self.submit(q)))
+        for j, h in pending:
+            results[j] = h.result()
+        wall = time.perf_counter() - t0
+        report = {
+            "requests": len(requests),
+            "total_queries": total_rows,
+            "wall_s": round(wall, 4),
+            "sustained_qps": round(total_rows / wall, 2) if wall > 0 else None,
+            "depth": depth,
+            **self.stats(),
+        }
+        return results, report
+
+    # -- observability -----------------------------------------------------
+    def _record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies_s.append(seconds)
+
+    def stats(self) -> dict:
+        """Compile/dispatch accounting + request latency percentiles —
+        the serving metrics JobResult/bench surface."""
+        with self._lock:
+            return {
+                "buckets": list(self.buckets),
+                "compile_count": int(sum(self._compiles.values())),
+                "executables": len(self._execs),
+                "per_bucket_compiles": {
+                    int(b): int(c) for b, c in sorted(self._compiles.items())
+                },
+                "per_bucket_dispatches": {
+                    int(b): int(c) for b, c in sorted(self._dispatches.items())
+                },
+                "requests": self._requests,
+                "donate_queries": self.donate_queries,
+                "latency_ms": latency_summary(self._latencies_s),
+            }

@@ -1,0 +1,228 @@
+"""Dynamic micro-batching: coalesce concurrent small requests into one
+bucketed dispatch.
+
+Single-query (or few-query) requests are the worst case for a systolic
+accelerator — each dispatch pays full program-launch latency for almost
+no math.  :class:`QueryQueue` holds arriving requests for at most
+``max_wait_ms`` and concatenates everything that accumulates into ONE
+engine dispatch (padded up the bucket ladder), then scatters the result
+rows back to each caller's future.  Because every query row's result is
+independent of its batchmates (see serving.engine), the scattered
+results are bitwise identical to submitting the coalesced batch
+directly — coalescing is purely a throughput/latency trade governed by
+``max_wait_ms``.
+
+Two threads: the **batcher** collects + dispatches (asynchronously — JAX
+returns before the device finishes), the **completer** blocks on
+transfers and resolves futures.  The batcher therefore keeps dispatching
+batch N+1 while batch N executes: micro-batching and dispatch-ahead
+compose.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class QueryQueue:
+    """Micro-batching frontend over a :class:`~knn_tpu.serving.engine.
+    ServingEngine`.
+
+    ``submit(queries)`` returns a ``concurrent.futures.Future`` resolving
+    to ``(distances, indices)`` (op="search") or ``labels`` (op="predict")
+    for exactly the submitted rows.  A batch dispatches as soon as
+    ``max_rows`` rows accumulate, or when the OLDEST pending request has
+    waited ``max_wait_ms`` — the deadline bounds worst-case added latency.
+
+    Use as a context manager, or call :meth:`close` (flushes pending
+    requests, then joins both threads).
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_wait_ms: float = 2.0,
+        max_rows: Optional[int] = None,
+        op: str = "search",
+    ):
+        from knn_tpu.serving.engine import OPS
+
+        if op not in OPS:
+            raise ValueError(f"unknown op {op!r}; expected one of {OPS}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self.engine = engine
+        self.op = op
+        self.max_wait_s = max_wait_ms / 1e3
+        self.max_rows = int(max_rows or engine.buckets[-1])
+        self._cond = threading.Condition()
+        #: (queries, future, arrival time) — arrival rides along so the
+        #: max-wait deadline is per request, not per batch window
+        self._pending: List[Tuple[np.ndarray, Future, float]] = []
+        self._pending_rows = 0
+        self._closed = False
+        self._stats = {"requests": 0, "dispatches": 0, "coalesced_rows": 0}
+        #: ARRIVAL-to-result latency of queued requests (bounded window):
+        #: the engine's own percentiles start at engine dispatch and so
+        #: exclude the micro-batching wait — this one is what a caller
+        #: tuning max_wait_ms actually experiences.  deque.append is
+        #: atomic, so the completer records without taking the cond.
+        self._lat: deque = deque(maxlen=4096)
+        self._done: _queue.Queue = _queue.Queue()
+        self._batcher_t = threading.Thread(
+            target=self._batcher, name="knn-serving-batcher", daemon=True)
+        self._completer_t = threading.Thread(
+            target=self._completer, name="knn-serving-completer", daemon=True)
+        self._batcher_t.start()
+        self._completer_t.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, queries) -> Future:
+        q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
+        if q.ndim != 2 or q.shape[1] != self.engine._dim:
+            # reject HERE, not in the batcher: a malformed request that
+            # reached the coalescing concatenate would kill the batch it
+            # rode in with (and the batcher guards survive, see _batcher)
+            raise ValueError(
+                f"queries must be [N, {self.engine._dim}], got shape "
+                f"{q.shape}")
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("QueryQueue is closed")
+            self._pending.append((q, fut, time.monotonic()))
+            self._pending_rows += q.shape[0]
+            self._stats["requests"] += 1
+            self._cond.notify_all()
+        return fut
+
+    def close(self) -> None:
+        """Flush every pending request, then stop both threads."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._batcher_t.join()
+        self._completer_t.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def stats(self) -> dict:
+        from knn_tpu.serving.engine import latency_summary
+
+        with self._cond:
+            out = dict(self._stats)
+        out["latency_ms"] = latency_summary(list(self._lat))
+        out["engine"] = self.engine.stats()
+        return out
+
+    # -- worker threads ----------------------------------------------------
+    @staticmethod
+    def _resolve(fut: Future, value=None, exc: Optional[Exception] = None):
+        """Resolve a future, tolerating client-side cancellation: a
+        caller that gave up (fut.cancel() after a timeout) must never
+        crash the worker thread that eventually completes its batch."""
+        if fut.cancelled():
+            return
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(value)
+        except Exception:  # noqa: BLE001 — cancelled in the race window
+            pass
+
+    def _take_batch(self) -> Optional[List[Tuple[np.ndarray, Future, float]]]:
+        """Block until a batch is due (rows >= max_rows, deadline hit, or
+        closing with work pending); None means closed and drained.
+        Entries keep their arrival times so the completer can report
+        honest arrival-to-result latency."""
+        with self._cond:
+            while True:
+                if self._pending:
+                    if self._closed or self._pending_rows >= self.max_rows:
+                        break
+                    # each request keeps its own arrival time, so one
+                    # left behind by a full earlier batch retains its
+                    # original deadline — max_wait_ms stays a real
+                    # worst-case bound, not a restartable clock
+                    wait = self._pending[0][2] + self.max_wait_s - time.monotonic()
+                    if wait <= 0:
+                        break
+                    self._cond.wait(timeout=wait)
+                elif self._closed:
+                    return None
+                else:
+                    self._cond.wait()
+            # whole requests only: a request is never split across
+            # micro-batches (oversize batches split inside the engine)
+            batch: List[Tuple[np.ndarray, Future, float]] = []
+            rows = 0
+            while self._pending and (
+                not batch or rows + self._pending[0][0].shape[0] <= self.max_rows
+            ):
+                batch.append(self._pending.pop(0))
+                rows += batch[-1][0].shape[0]
+            self._pending_rows -= rows
+            return batch
+
+    def _batcher(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                break
+            try:
+                # the concatenate sits INSIDE the guard: any surprise in
+                # batch assembly must resolve this batch's futures, never
+                # kill the batcher thread (a dead batcher hangs every
+                # later request and deadlocks close())
+                arrays = [q for q, _, _ in batch]
+                cat = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
+                offsets = np.cumsum([0] + [a.shape[0] for a in arrays])
+                handle = self.engine.submit(cat, op=self.op)
+            except Exception as e:  # noqa: BLE001 — resolve, don't kill the loop
+                for _, fut, _ in batch:
+                    self._resolve(fut, exc=e)
+                continue
+            with self._cond:
+                self._stats["dispatches"] += 1
+                self._stats["coalesced_rows"] += int(offsets[-1])
+            self._done.put((handle, batch, offsets))
+        self._done.put(None)
+
+    # -- completer thread --------------------------------------------------
+    def _completer(self) -> None:
+        while True:
+            item = self._done.get()
+            if item is None:
+                break
+            handle, batch, offsets = item
+            try:
+                res = handle.result()
+            except Exception as e:  # noqa: BLE001 — per-batch failure isolation
+                for _, fut, _ in batch:
+                    self._resolve(fut, exc=e)
+                continue
+            done_t = time.monotonic()
+            for j, (_, fut, t_arr) in enumerate(batch):
+                lo, hi = int(offsets[j]), int(offsets[j + 1])
+                if self.op == "search":
+                    d, i = res
+                    self._resolve(fut, (d[lo:hi], i[lo:hi]))
+                else:
+                    self._resolve(fut, res[lo:hi])
+                self._lat.append(done_t - t_arr)

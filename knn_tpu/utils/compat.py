@@ -1,0 +1,33 @@
+"""JAX version-compat helpers shared by the entry points.
+
+Kept separate from utils.config (which must stay importable without
+JAX) and from parallel.collectives (whose shard_map shim is the other
+compat seam): everything here touches ``jax.config`` and must run
+BEFORE backend initialization.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def request_cpu_devices(n: int) -> None:
+    """Force the CPU backend with ``n`` virtual devices.
+
+    Must run before any backend use; a ``RuntimeError`` (backend already
+    initialized) propagates to the caller, who knows whether a
+    preconfigured backend is acceptable.  Newer jax spells the device
+    count ``jax_num_cpu_devices``; older versions only honor the XLA
+    flag, which this sets as the fallback (same mechanism as
+    tests/conftest.py).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        ).strip()

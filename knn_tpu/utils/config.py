@@ -66,6 +66,16 @@ class JobConfig:
     mode: str = "exact"
     #: local-shard selector for certified mode: "approx" | "pallas" | "exact"
     selector: str = "approx"
+    #: shape-bucketed serving (knn_tpu.serving): "auto" for the default
+    #: geometric ladder, or an explicit comma list like "64,128,256".
+    #: Queries route through precompiled per-bucket executables and the
+    #: job metrics gain per-bucket compile counts + latency percentiles.
+    #: None (default) = direct dispatch, one compile per batch shape.
+    serve_buckets: Optional[str] = None
+    #: micro-batching deadline (knn_tpu.serving.QueryQueue): how long a
+    #: request may wait to be coalesced with others.  Echoed into the
+    #: serving metrics; only a concurrent-request queue consults it.
+    max_wait_ms: float = 2.0
     # --- native backend knobs ---
     num_threads: int = 0  # 0 = hardware concurrency
 
@@ -91,6 +101,23 @@ class JobConfig:
         ):
             raise ValueError(
                 "mode='certified' requires the l2 or cosine metric")
+        if self.serve_buckets is not None:
+            # dependency-free ladder validation (knn_tpu.serving.buckets
+            # imports no jax/numpy), so bad flags fail at parse time
+            from knn_tpu.serving.buckets import parse_buckets
+
+            if parse_buckets(self.serve_buckets) is None:
+                self.serve_buckets = None  # empty spec = serving off
+            if self.serve_buckets is not None and self.mode == "certified":
+                raise ValueError(
+                    "serve_buckets routes through the exact bucketed "
+                    "programs; mode='certified' has its own batching "
+                    "(batch_size) and does not compose with it")
+            if self.serve_buckets is not None and self.backend != "jax":
+                raise ValueError("serve_buckets requires the jax backend")
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)

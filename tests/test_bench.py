@@ -168,6 +168,17 @@ def test_bench_glove_cosine_runs_certified_library_path():
     assert rec["value"] > 0
     assert rec["metric_fn"].startswith("cosine")
     sels = rec["selectors"]
-    assert set(sels) == {"exact", "certified_approx", "certified_pallas"}
+    assert set(sels) == {"exact", "certified_approx", "certified_pallas",
+                         "serving"}
     for name, sel in sels.items():
+        if name == "serving":
+            # trace replay, not a recall-gated sweep: sustained rate +
+            # tail latency + the compile bound instead of recall_at_k
+            assert sel["sustained_qps"] > 0, sel
+            assert {"p50", "p95", "p99"} <= set(sel["latency_ms"]), sel
+            assert sel["compile_count"] <= len(sel["bucket_ladder"]), sel
+            continue
         assert sel.get("recall_at_k") == 1.0, (name, sel)
+    # the traffic numbers are hoisted to the top level of the JSON line
+    assert rec["serving_sustained_qps"] > 0
+    assert rec["serving_latency_ms"]["p99"] >= rec["serving_latency_ms"]["p50"]

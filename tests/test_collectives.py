@@ -21,6 +21,7 @@ from knn_tpu.parallel import (
     make_mesh,
     replicate,
     shard,
+    shard_map_compat,
 )
 
 
@@ -49,7 +50,7 @@ def test_gather_reassembles_shards(rng):
     x = rng.normal(size=(24, 4)).astype(np.float32)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda q: gather(q, QUERY_AXIS),
             mesh=mesh,
             in_specs=P(QUERY_AXIS),
@@ -65,7 +66,7 @@ def test_gather_stacked_gives_device_axis(rng):
     x = np.arange(8, dtype=np.float32)[:, None]
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda q: gather(q, QUERY_AXIS, tiled=False),
             mesh=mesh,
             in_specs=P(QUERY_AXIS),
@@ -86,7 +87,7 @@ def test_allreduce_extrema_match_global(rng):
         return lo, hi
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             spmd, mesh=mesh,
             in_specs=P((QUERY_AXIS, DB_AXIS)),
             out_specs=(P(), P()),

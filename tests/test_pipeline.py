@@ -176,3 +176,63 @@ def test_config_validation():
         JobConfig(validation=True, val_file=None)
     cfg = JobConfig()
     assert JobConfig.from_json(cfg.to_json()) == cfg
+
+
+def test_run_job_serving_buckets_matches_direct(job_files):
+    """--serve-buckets routes classification through the bucketed
+    serving engine: identical labels, serving metrics (per-bucket
+    compile counts + latency percentiles) in JobResult.metrics()."""
+    paths, test_l = job_files
+    direct = run_job(_config(paths))
+    served = run_job(_config(paths, serve_buckets="8,16,32", batch_size=13))
+    np.testing.assert_array_equal(direct.test_labels, served.test_labels)
+    np.testing.assert_array_equal(direct.val_labels, served.val_labels)
+    assert "serving_warmup" in served.phase_times
+    m = served.metrics()["serving"]
+    assert m["buckets"] == [8, 16, 32]
+    # warmup compiled every bucket; the job loop added NO compiles
+    assert m["compile_count"] <= len(m["buckets"])
+    assert sum(m["per_bucket_dispatches"].values()) == m["requests"]
+    assert m["latency_ms"]["count"] == m["requests"]
+    assert m["latency_ms"]["p50"] <= m["latency_ms"]["p99"]
+    assert m["max_wait_ms"] == 2.0
+    # direct runs carry no serving block
+    assert "serving" not in direct.metrics()
+
+
+def test_cli_serve_buckets_flag(job_files, tmp_path, capsys):
+    paths, test_l = job_files
+    metrics_path = str(tmp_path / "metrics_serving.json")
+    rc = cli_main(
+        [
+            "--train", paths["train"],
+            "--test", paths["test"],
+            "--val", paths["val"],
+            "--out", paths["out"],
+            "--k", "5",
+            "--serve-buckets", "8,32",
+            "--max-wait-ms", "1.5",
+            "--metrics-json", metrics_path,
+        ]
+    )
+    assert rc == 0
+    assert np.mean(read_labels(paths["out"]) == test_l) >= 0.95
+    m = json.load(open(metrics_path))
+    assert m["serving"]["buckets"] == [8, 32]
+    assert m["serving"]["max_wait_ms"] == 1.5
+    assert m["config"]["serve_buckets"] == "8,32"
+
+
+def test_config_serving_validation():
+    with pytest.raises(ValueError, match="bad bucket spec"):
+        JobConfig(serve_buckets="8,x")
+    with pytest.raises(ValueError, match="does not compose"):
+        JobConfig(serve_buckets="auto", mode="certified")
+    with pytest.raises(ValueError, match="jax backend"):
+        JobConfig(serve_buckets="auto", backend="native")
+    with pytest.raises(ValueError, match="max_wait_ms"):
+        JobConfig(max_wait_ms=-0.5)
+    # empty spec disables serving instead of erroring
+    assert JobConfig(serve_buckets="").serve_buckets is None
+    cfg = JobConfig(serve_buckets="16,64", max_wait_ms=3.0)
+    assert JobConfig.from_json(cfg.to_json()) == cfg

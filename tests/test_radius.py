@@ -307,3 +307,40 @@ def test_sharded_radius_cosine(data):
         got = set(i[qi][i[qi] != SENTINEL_IDX].tolist())
         assert got == want, qi
         assert c[qi] == len(want)
+
+
+def test_cityblock_alias_matches_l1(data):
+    """ADVICE r5: 'cityblock' passes radius_threshold's eager validation
+    but used to die inside the search dispatch — the alias must now run,
+    and run IDENTICALLY to 'l1' (same threshold, same dispatch)."""
+    db, q = data
+    d_l1, i_l1, c_l1 = radius_search(q, db, 9.0, max_neighbors=16,
+                                     metric="l1")
+    d_cb, i_cb, c_cb = radius_search(q, db, 9.0, max_neighbors=16,
+                                     metric="cityblock")
+    np.testing.assert_array_equal(np.asarray(d_l1), np.asarray(d_cb))
+    np.testing.assert_array_equal(np.asarray(i_l1), np.asarray(i_cb))
+    np.testing.assert_array_equal(np.asarray(c_l1), np.asarray(c_cb))
+    # count_within dispatches the alias too
+    np.testing.assert_array_equal(
+        np.asarray(count_within(db, q, 9.0, "cityblock")),
+        np.asarray(count_within(db, q, 9.0, "l1")),
+    )
+
+
+def test_sharded_radius_l1_falls_back_to_single_device(data):
+    """The docstring's promised L1 fallback exists: a host-array-built
+    ShardedKNN routes L1 radius queries through the single-device
+    ops.radius path (one pairwise computation for mask AND count), with
+    results identical to calling it directly."""
+    db, q = data
+    d64 = _oracle_d(db, q, "l1")
+    radius = _safe_radius(d64, 0.02)
+    M = max(len(s) for s in _sets(d64, radius)) + 3
+    ref = radius_search(q, db, radius, max_neighbors=M, metric="l1")
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5, metric="l1")
+    got = prog.radius_search(q, radius, max_neighbors=M)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(ValueError):
+        prog.radius_search(q, radius, max_neighbors=0)
