@@ -41,8 +41,13 @@ Env overrides:
   KNN_BENCH_DTYPE    (bfloat16 | float32; default per config)
   KNN_BENCH_PEAK_FLOPS    override the per-chip peak used for MFU
   KNN_BENCH_PLATFORM      force a JAX platform (e.g. "cpu") before init
-  KNN_BENCH_TRACE         write a jax.profiler trace of each mode's last run
-                          under this directory (TensorBoard-viewable)
+  KNN_BENCH_TRACE         write a jax.profiler trace of one extra per-mode
+                          run under this directory (TensorBoard-viewable;
+                          the --trace-dir flag is equivalent)
+  KNN_BENCH_PALLAS_KERNEL tiled | streaming (db-streaming strategy);
+                          unset pallas knobs resolve through the
+                          knn_tpu.tuning winner cache (see
+                          KNN_BENCH_TUNE_CACHE / `knn_tpu.cli tune`)
   KNN_BENCH_INIT_TIMEOUT  seconds before backend init is declared hung (480)
   KNN_BENCH_FALLBACK_CPU  run on CPU if accelerator init fails — DEFAULT ON
                           (the JSON records backend+device so the number
@@ -50,6 +55,7 @@ Env overrides:
                           round record — BENCH_r03).  Set 0 to disable.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -57,6 +63,27 @@ import time
 import traceback
 
 import numpy as np
+
+
+def _parse_args(argv=None):
+    """The bench's (tiny) flag surface — unknown args are ignored so the
+    driver's bare ``python bench.py`` invocation stays untouched."""
+    p = argparse.ArgumentParser(
+        prog="bench.py",
+        description="KNN throughput bench; prints exactly one JSON line",
+    )
+    p.add_argument(
+        "--trace-dir", default=os.environ.get("KNN_BENCH_TRACE"),
+        metavar="DIR",
+        help="capture a jax.profiler trace artifact (utils.timing.trace, "
+        "TensorBoard-loadable) of one extra per-mode run under DIR, "
+        "alongside the bench JSON; equivalent to KNN_BENCH_TRACE",
+    )
+    args, _ = p.parse_known_args(argv)
+    return args
+
+
+ARGS = _parse_args()
 
 
 def _env_int(name, default):
@@ -91,22 +118,30 @@ try:
     #: 4x larger denominator sample; cpu_queries + per-query time stay in
     #: the JSON so the claim is auditable.
     CPU_QUERIES = _env_int("KNN_BENCH_CPU_QUERIES", 256)
-    #: pallas-certified kernel matmul mode (ops.pallas_knn.PRECISIONS)
-    PALLAS_PRECISION = os.environ.get("KNN_BENCH_PALLAS_PRECISION", "bf16x3")
-    #: pallas kernel geometry overrides (None = ops.pallas_knn defaults);
-    #: the defaults are the measured sweep winners on v5e (TUNING_r03)
+    #: pallas kernel knob OVERRIDES.  Unset env = None = resolve through
+    #: knn_tpu.tuning (the persisted autotuner winner for this exact
+    #: (device_kind, n, d, k, metric, dtype) when one exists, else the
+    #: library defaults); a SET env var always wins over both — the same
+    #: precedence ShardedKNN.search_certified applies, so the bench and
+    #: the library can never run different knobs for the same request.
+    PALLAS_PRECISION = os.environ.get("KNN_BENCH_PALLAS_PRECISION")
     PALLAS_TILE = _env_opt_int("KNN_BENCH_PALLAS_TILE")
     PALLAS_BIN_W = _env_opt_int("KNN_BENCH_PALLAS_BIN_W")
     PALLAS_SURVIVORS = _env_opt_int("KNN_BENCH_PALLAS_SURVIVORS")
     PALLAS_BLOCK_Q = _env_opt_int("KNN_BENCH_PALLAS_BLOCK_Q")
-    PALLAS_FINAL = os.environ.get("KNN_BENCH_PALLAS_FINAL", "approx")
+    PALLAS_FINAL = os.environ.get("KNN_BENCH_PALLAS_FINAL")
     #: select-phase layout (ops.pallas_knn.BINNINGS): "grouped" = lane-
     #: indexed bins, shuffle-free select (round-4); "lane" = round-3
-    PALLAS_BINNING = os.environ.get("KNN_BENCH_PALLAS_BINNING", "grouped")
+    PALLAS_BINNING = os.environ.get("KNN_BENCH_PALLAS_BINNING")
     #: grid iteration order (ops.pallas_knn.GRID_ORDERS): "db_major"
     #: streams each db tile once per sweep instead of once per query
     #: block (r5 cost model); opt-in pending the hardware gate + A/B
-    PALLAS_GRID = os.environ.get("KNN_BENCH_PALLAS_GRID", "query_major")
+    PALLAS_GRID = os.environ.get("KNN_BENCH_PALLAS_GRID")
+    #: db-streaming strategy (ops.pallas_knn.KERNELS): "tiled" | the
+    #: one-launch double-buffered "streaming"
+    PALLAS_KERNEL = os.environ.get("KNN_BENCH_PALLAS_KERNEL")
+    #: autotuner cache file override (KNN_TPU_TUNE_CACHE also works)
+    TUNE_CACHE = os.environ.get("KNN_BENCH_TUNE_CACHE")
     #: recall target of the one-pass path's final ApproxTopK (None =
     #: library default 0.999); misses surface as fallbacks, never
     #: as unsound certificates
@@ -151,7 +186,33 @@ _PEAK_BY_KIND = {
 }
 
 
+_GIT_COMMIT_MEMO = [False]  # False = not probed yet (None = no repo)
+
+
+def _git_commit():
+    """Short git HEAD stamped into every emitted line, so a session
+    measurement carries its own code provenance into curation
+    (scripts/refresh_bench_artifacts.py's measured_at_commit).  Probed
+    once per process — _emit may run several times (error paths)."""
+    if _GIT_COMMIT_MEMO[0] is not False:
+        return _GIT_COMMIT_MEMO[0]
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        _GIT_COMMIT_MEMO[0] = r.stdout.strip() or None
+    except Exception:
+        _GIT_COMMIT_MEMO[0] = None
+    return _GIT_COMMIT_MEMO[0]
+
+
 def _emit(obj):
+    commit = _git_commit()
+    if commit and "measured_at_commit" not in obj:
+        obj = {**obj, "measured_at_commit": commit}
     print(json.dumps(obj))
     sys.stdout.flush()
 
@@ -579,6 +640,33 @@ def main() -> None:
             del prog  # free the bf16 placement before the rebuild
             prog = build(DTYPE)
 
+    # resolve the pallas knobs ONCE (after the dtype demotion so the key
+    # matches the placement search_certified will see): env overrides >
+    # persisted autotuner winner (`python -m knn_tpu.cli tune`) > library
+    # defaults.  One exception preserved from two rounds of measurement:
+    # on a cache MISS with no env pin, the bench keeps its historical
+    # "approx" final (the measured relay-side winner, TUNING_r03)
+    # instead of the library's "exact" default; a cache HIT carries a
+    # MEASURED final_select (tuning.knob_grid searches it at every
+    # level), so the winner rightly takes precedence then.
+    from knn_tpu import tuning
+
+    KNOBS, TUNE_INFO = tuning.resolve_full(
+        N, DIM, K, metric="l2" if METRIC == "cosine" else METRIC,
+        dtype=DTYPE, cache_path=TUNE_CACHE,
+        overrides=dict(
+            tile_n=PALLAS_TILE, block_q=PALLAS_BLOCK_Q, bin_w=PALLAS_BIN_W,
+            survivors=PALLAS_SURVIVORS, precision=PALLAS_PRECISION,
+            final_select=PALLAS_FINAL, binning=PALLAS_BINNING,
+            grid_order=PALLAS_GRID, final_recall_target=PALLAS_FINAL_RT,
+            kernel=PALLAS_KERNEL,
+        ),
+    )
+    if TUNE_INFO["source"] == "default" and "final_select" not in \
+            TUNE_INFO["overridden"]:
+        KNOBS["final_select"] = "approx"
+    _vlog(f"pallas knobs ({TUNE_INFO['source']}): {KNOBS}")
+
     def batches(qs):
         for lo in range(0, qs.shape[0], BATCH):
             chunk = qs[lo : lo + BATCH]
@@ -603,17 +691,14 @@ def main() -> None:
             if selector == "pallas":
                 # ONE device pass; PALLAS_BATCH pipelines the d2h
                 # transfer of batch b under the device compute of the
-                # batches behind it (None = one big batch+transfer)
+                # batches behind it (None = one big batch+transfer).
+                # The resolved KNOBS pass as explicit values, so the
+                # library-side resolve is a no-op re-statement of them.
                 _, i, st = prog.search_certified(
                     qs, margin=MARGIN, selector=selector,
                     batch_size=PALLAS_BATCH,
-                    precision=PALLAS_PRECISION, tile_n=PALLAS_TILE,
-                    bin_w=PALLAS_BIN_W, survivors=PALLAS_SURVIVORS,
-                    block_q=PALLAS_BLOCK_Q,
-                    final_select=PALLAS_FINAL, binning=PALLAS_BINNING,
-                    final_recall_target=PALLAS_FINAL_RT,
-                    grid_order=PALLAS_GRID,
                     return_distances=return_distances,
+                    **KNOBS,
                 )
                 return i, st
             # counted path: all coarse selects dispatch up front, host
@@ -663,6 +748,9 @@ def main() -> None:
             "executables": report["executables"],
             "per_bucket_dispatches": report["per_bucket_dispatches"],
             "donate_queries": report["donate_queries"],
+            # which kernel knobs a certified path on this placement
+            # would resolve (persisted winner vs defaults)
+            "tuning": report.get("tuning"),
         }
 
     sweeps = {
@@ -686,16 +774,18 @@ def main() -> None:
 
         import jax as _jax
 
-        from knn_tpu.parallel.sharded import unpack_certified
+        from knn_tpu.parallel.sharded import DB_AXIS, unpack_certified
 
         # the same program+geometry the timed sweep ran (ONE source of
-        # truth: ShardedKNN._pallas_setup)
+        # truth: ShardedKNN._pallas_setup, fed the same resolved KNOBS)
         pp, m, w = prog._pallas_setup(
-            MARGIN, PALLAS_TILE, PALLAS_PRECISION, bin_w=PALLAS_BIN_W,
-            survivors=PALLAS_SURVIVORS, block_q=PALLAS_BLOCK_Q,
-            final_select=PALLAS_FINAL,
-            binning=PALLAS_BINNING, final_recall_target=PALLAS_FINAL_RT,
-            grid_order=PALLAS_GRID,
+            MARGIN, KNOBS["tile_n"], KNOBS["precision"],
+            bin_w=KNOBS["bin_w"],
+            survivors=KNOBS["survivors"], block_q=KNOBS["block_q"],
+            final_select=KNOBS["final_select"],
+            binning=KNOBS["binning"],
+            final_recall_target=KNOBS["final_recall_target"],
+            grid_order=KNOBS["grid_order"], kernel=KNOBS["kernel"],
         )
         pb_queries = queries
         if METRIC == "cosine":
@@ -729,7 +819,27 @@ def main() -> None:
                           d32k=dk.astype(np.float64))
         host = time.perf_counter() - t0
         mb = packed.nbytes / 1e6
+        # kernel launch accounting (ONE home for the arithmetic:
+        # ops.pallas_knn): the tiled grid re-launches its pipelined body
+        # once per train tile; the streaming kernel is one launch per
+        # (batch, shard) whose in-kernel DMA loop covers every tile
+        from knn_tpu.ops.pallas_knn import (
+            BIN_W as _BIN_W,
+            TILE_N as _TILE_N,
+            effective_tile,
+            kernel_launches_per_batch,
+        )
+
+        shard_rows = prog._tp.shape[0] // prog.mesh.shape[DB_AXIS]
+        eff = effective_tile(
+            shard_rows, KNOBS["tile_n"] or _TILE_N,
+            KNOBS["bin_w"] or _BIN_W, KNOBS["survivors"],
+            KNOBS["binning"], m + 2)
         return {
+            "kernel": KNOBS["kernel"],
+            "db_tiles_per_shard": -(-shard_rows // eff),
+            "kernel_launches_per_batch_shard": kernel_launches_per_batch(
+                KNOBS["kernel"], shard_rows, eff),
             "h2d_queries_s": round(h2d, 4),
             "device_s": round(dev, 4),
             "device_qps": round(NQ / dev, 1),
@@ -829,15 +939,18 @@ def main() -> None:
         g_k = min(K, 100)
         _, oracle = host_exact_knn(g_db, g_q, g_k)
         # gate the SAME kernel configuration the sweeps run (precision,
-        # geometry, final select) — the round-3 failure was build-detail
-        # dependent, so checking a different program proves nothing
+        # geometry, final select, db-streaming strategy) — the round-3
+        # failure was build-detail dependent, so checking a different
+        # program proves nothing
         _, idx, g_stats = knn_search_pallas(
-            g_q, g_db, g_k, precision=PALLAS_PRECISION,
-            tile_n=PALLAS_TILE or TILE_N_DEFAULT, bin_w=PALLAS_BIN_W,
-            survivors=PALLAS_SURVIVORS, block_q=PALLAS_BLOCK_Q,
-            final_select=PALLAS_FINAL,
-            binning=PALLAS_BINNING, final_recall_target=PALLAS_FINAL_RT,
-            grid_order=PALLAS_GRID,
+            g_q, g_db, g_k, precision=KNOBS["precision"],
+            tile_n=KNOBS["tile_n"] or TILE_N_DEFAULT,
+            bin_w=KNOBS["bin_w"],
+            survivors=KNOBS["survivors"], block_q=KNOBS["block_q"],
+            final_select=KNOBS["final_select"],
+            binning=KNOBS["binning"],
+            final_recall_target=KNOBS["final_recall_target"],
+            grid_order=KNOBS["grid_order"], kernel=KNOBS["kernel"],
         )
         return {
             "pallas_gate_ok": bool((idx == oracle).all()),
@@ -858,7 +971,7 @@ def main() -> None:
             gate = {"pallas_gate_ok": None,
                     "gate_error": f"{type(e).__name__}: {e}"}
 
-    trace_dir = os.environ.get("KNN_BENCH_TRACE")
+    trace_dir = ARGS.trace_dir
     results = {}
     for mode in modes:
         entry = {}
@@ -893,13 +1006,18 @@ def main() -> None:
             _vlog(f"mode {mode}: done ({round(NQ / float(np.mean(times)), 1)} q/s)")
             if trace_dir:
                 # one extra instrumented run, OUTSIDE the timed stats —
-                # profiler overhead must not skew the headline numbers
-                from jax.profiler import trace as _trace
+                # profiler overhead must not skew the headline numbers.
+                # utils.timing.trace wraps jax.profiler.trace, so the
+                # artifact is the on-chip XLA trace the round-5 verdict
+                # marked missing, TensorBoard-loadable from <dir>/<mode>
+                from knn_tpu.utils.timing import trace as _trace
 
-                with _trace(os.path.join(trace_dir, mode)):
+                tdir = os.path.join(trace_dir, mode)
+                with _trace(tdir):
                     t0 = time.perf_counter()
                     fn(queries)
                     entry["traced_run_s"] = round(time.perf_counter() - t0, 4)
+                entry["trace_dir"] = tdir
             times = np.asarray(times)
             qps = NQ / times
             flops = 2.0 * NQ * N * DIM * passes[mode]
@@ -1038,16 +1156,11 @@ def main() -> None:
         "batch": NQ if best == "certified_pallas" else BATCH,
         "train_tile": tile,
         # the EFFECTIVE pallas/approx tuning knobs, so a curated artifact
-        # line is reproducible from the line itself (ADVICE r2+r3)
-        "pallas_knobs": {
-            "precision": PALLAS_PRECISION, "tile_n": PALLAS_TILE,
-            "bin_w": PALLAS_BIN_W, "survivors": PALLAS_SURVIVORS,
-            "block_q": PALLAS_BLOCK_Q,
-            "final_select": PALLAS_FINAL, "binning": PALLAS_BINNING,
-            "grid_order": PALLAS_GRID,
-            "final_recall_target": PALLAS_FINAL_RT, "batch": PALLAS_BATCH,
-            "margin": MARGIN,
-        },
+        # line is reproducible from the line itself (ADVICE r2+r3); the
+        # tuning block records where each run's knobs came from
+        # (persisted autotuner winner vs defaults vs env overrides)
+        "pallas_knobs": {**KNOBS, "batch": PALLAS_BATCH, "margin": MARGIN},
+        "tuning": TUNE_INFO,
         "approx_knobs": {"recall_target": APPROX_RT,
                          "margin": APPROX_MARGIN},
     })

@@ -10,6 +10,17 @@ Usage mirrors the reference job:
 
 Prints the reference's two lines (``accuracy = ...`` knn_mpi.cpp:348 and
 ``Running time is ... second`` :398) plus optional structured JSON metrics.
+
+One subcommand rides alongside the job interface:
+
+    python -m knn_tpu.cli tune --n 1000000 --dim 128 --k 100
+
+runs the deterministic kernel autotuner (knn_tpu.tuning) for that
+problem shape on whatever backend JAX exposes and persists the winning
+knob set to the on-disk cache, where every subsequent
+``search_certified``/bench run on the same device kind resolves it with
+zero re-timing — the reproducible replacement for the per-session hand
+search of scripts/tpu_session_r5b.py.
 """
 
 from __future__ import annotations
@@ -78,7 +89,93 @@ def build_parser() -> argparse.ArgumentParser:
         help="force an N-virtual-device CPU backend (testing without a TPU; "
         "must be set before any other JAX use in the process)",
     )
+    p.add_argument(
+        "--tune-cache", default=None, metavar="PATH",
+        help="autotuner winner-cache file for --mode certified "
+        "--selector pallas (default: $KNN_TPU_TUNE_CACHE or "
+        "~/.cache/knn_tpu/autotune.json; populate it with the `tune` "
+        "subcommand)",
+    )
     return p
+
+
+def build_tune_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu tune",
+        description="Autotune the Pallas kernel for one problem shape and "
+        "persist the winner (knn_tpu.tuning); a second run for the same "
+        "(device kind, n, dim, k, metric, dtype) resolves from the cache "
+        "with zero re-timing.",
+    )
+    p.add_argument("--n", type=int, default=100_000, help="database rows")
+    p.add_argument("--dim", type=int, default=128, help="feature dim")
+    p.add_argument("--k", type=int, default=100, help="neighbor count")
+    p.add_argument("--metric", default="l2",
+                   choices=("l2", "sql2", "euclidean"))
+    p.add_argument("--dtype", default="float32",
+                   choices=("float32", "bfloat16"),
+                   help="placement compute dtype the winner is keyed for "
+                   "(a cache-key field: the bench's headline configs place "
+                   "bfloat16, so tune with --dtype bfloat16 for them; the "
+                   "kernel's own arithmetic is f32 either way)")
+    p.add_argument("--queries", type=int, default=256,
+                   help="timing/gate query count")
+    p.add_argument("--margin", type=int, default=28, help="candidate margin")
+    p.add_argument("--grid", default="standard",
+                   choices=("quick", "standard", "full"),
+                   help="knob grid size (tuning.knob_grid)")
+    p.add_argument("--runs", type=int, default=2,
+                   help="timed repetitions per candidate (fenced)")
+    p.add_argument("--seed", type=int, default=0, help="synthetic data seed")
+    p.add_argument("--cache", default=None, metavar="PATH",
+                   help="cache file (default: $KNN_TPU_TUNE_CACHE or "
+                   "~/.cache/knn_tpu/autotune.json)")
+    p.add_argument("--force", action="store_true",
+                   help="re-search even when a cached winner exists")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the result record to this path")
+    p.add_argument("--cpu-devices", type=int, default=None, metavar="N",
+                   help="force an N-virtual-device CPU backend")
+    return p
+
+
+def run_tune(args: argparse.Namespace) -> int:
+    """The `tune` subcommand: synthetic data at the requested shape ->
+    tuning.autotune -> one human-readable summary + one JSON line
+    (winner, per-candidate timings, counters — the zero-re-timing
+    evidence rides in the counters)."""
+    import json
+
+    import numpy as np
+
+    from knn_tpu import tuning
+
+    rng = np.random.default_rng(args.seed)
+    db = (rng.random(size=(args.n, args.dim)) * 128.0).astype(np.float32)
+    queries = (rng.random(size=(args.queries, args.dim)) * 128.0).astype(
+        np.float32)
+    tuning.reset_counters()
+    entry = tuning.autotune(
+        db, queries, args.k, metric=args.metric, margin=args.margin,
+        grid_level=args.grid, runs=args.runs, cache_path=args.cache,
+        dtype=None if args.dtype == "float32" else args.dtype,
+        force=args.force,
+    )
+    record = {**entry, "counters": tuning.counters()}
+    if entry["cached"]:
+        print(f"cached winner for {record['cache_key']}: "
+              f"{entry['winner']} ({entry['winner_ms']} ms) — "
+              f"0 candidates re-timed")
+    else:
+        print(f"tuned {record['cache_key']}: winner {entry['winner']} "
+              f"({entry['winner_ms']} ms) from "
+              f"{len(entry['timings_ms'])} candidates -> "
+              f"{record['cache_path']}")
+    print(json.dumps(record))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(record, f, indent=2)
+    return 0
 
 
 def args_to_config(args: argparse.Namespace) -> JobConfig:
@@ -105,10 +202,22 @@ def args_to_config(args: argparse.Namespace) -> JobConfig:
         serve_buckets=args.serve_buckets,
         max_wait_ms=args.max_wait_ms,
         num_threads=args.num_threads,
+        tune_cache=args.tune_cache,
     )
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["tune"]:
+        # subcommand dispatch by leading token: the legacy flat job
+        # interface (required --train/--test) stays byte-compatible for
+        # every existing caller, and `tune` gets its own parser
+        targs = build_tune_parser().parse_args(argv[1:])
+        if targs.cpu_devices:
+            from knn_tpu.utils.compat import request_cpu_devices
+
+            request_cpu_devices(targs.cpu_devices)
+        return run_tune(targs)
     args = build_parser().parse_args(argv)
     if args.cpu_devices:
         # Must precede backend initialization; env vars are too late when a

@@ -72,6 +72,30 @@ This is the ApproxTopK/PartialReduce shape (TPU-KNN paper, PAPERS.md) made
 exact: fused with the distance matmul, two survivors instead of one, and a
 sound exclusion bound instead of a recall target.
 
+Two DB-STREAMING STRATEGIES share the select/emit machinery (``kernel``,
+see ``KERNELS``):
+
+- ``"tiled"`` (default): grid = (q_blocks, db_tiles, dim_chunks); the
+  Pallas pipeline re-launches the kernel body once per train tile and
+  each (query block, db tile) cell round-trips its survivor block
+  through HBM before the XLA final select.
+- ``"streaming"``: grid = (q_blocks,) — ONE kernel launch per
+  (batch, shard).  The db tiles stay in HBM and stream through a
+  double-buffered pair of VMEM scratch buffers via explicit async
+  copies: while the MXU computes distances + the per-bin select on
+  tile i, the DMA engine prefetches tile i+1 into the other slot.
+  The per-tile survivor blocks accumulate in the VMEM-resident output
+  block across the whole in-kernel tile loop (the running
+  (distance, index) candidate list) and flush to HBM once per query
+  block, instead of once per (query block, db tile) cell.  Outputs are
+  BITWISE-IDENTICAL to the tiled kernel — both run the same emitters
+  on the same per-tile scores — so the downstream certified pipeline
+  is unchanged and interpret-mode equality is testable
+  (tests/test_pallas_streaming.py).  Opt-in until the on-hardware gate
+  + A/B pass on it (the same discipline grouped/db_major went
+  through); the autotuner (knn_tpu.tuning) carries it in the default
+  knob grid so the next TPU session measures it.
+
 Runs in interpret mode off-TPU so the CPU test suite covers it; the TPU
 session script (scripts/tpu_session.py) gates the *compiled* kernel against
 the float64 oracle before any benchmark run.
@@ -173,6 +197,24 @@ BINNINGS = ("grouped", "lane")
 #: db_major is opt-in until the on-hardware gate + A/B pass on it
 #: (the same discipline the grouped select went through).
 GRID_ORDERS = ("query_major", "db_major")
+
+#: db-streaming strategies (module docstring).  "tiled" = the Pallas
+#: grid pipeline re-launches the body per train tile; "streaming" = one
+#: launch per (batch, shard) with explicit double-buffered HBM->VMEM
+#: async copies and the candidate list carried in VMEM across tiles.
+KERNELS = ("tiled", "streaming")
+
+
+def kernel_launches_per_batch(kernel: str, rows: int, tile_n: int) -> int:
+    """Db-streaming kernel dispatches per (batch, shard) — the number
+    the bench publishes so launch accounting has ONE home: the tiled
+    grid re-launches its pipelined body once per train tile; the
+    streaming kernel is ONE launch whose in-kernel loop covers every
+    tile."""
+    if kernel not in KERNELS:
+        raise ValueError(f"kernel {kernel!r} not in {KERNELS}")
+    n_tiles = -(-rows // tile_n)
+    return 1 if kernel == "streaming" else n_tiles
 
 
 def _geometry(
@@ -290,13 +332,21 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
     # XLA f32 reduction once per call instead of a per-cell ones-matmul
     # (which cost ~12% of the qt matmul as a 6-pass f32 HIGHEST dot)
     emit = _emit_select_grouped if binning == "grouped" else _emit_select
+
+    def write(qt_acc):
+        cd, ci, bound = emit(
+            ti, qt_acc, tn_ref[:], tile_n=tile_n, bin_w=bin_w,
+            n_bins=n_bins, survivors=survivors, out_w=out_w,
+            bound_w=bound_w)
+        d_ref[:] = cd
+        i_ref[:] = ci
+        b_ref[:] = bound
+
     if nd == 1:
         # single dim chunk: no scratch allocated, skip the VMEM
         # accumulation round-trip entirely (measured ~16% of kernel time
         # at SIFT shape)
-        emit(ti, qt, tn_ref[:], d_ref, i_ref, b_ref,
-             tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
-             survivors=survivors, out_w=out_w, bound_w=bound_w)
+        write(qt)
         return
     qt_ref, = scratch
 
@@ -310,19 +360,20 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
 
     @pl.when(di == nd - 1)
     def _select():
-        emit(ti, qt_ref[:], tn_ref[:], d_ref, i_ref, b_ref,
-             tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
-             survivors=survivors, out_w=out_w, bound_w=bound_w)
+        write(qt_ref[:])
 
 
-def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
+def _emit_select(ti, qt, tn, *,
                  tile_n: int, bin_w: int, n_bins: int, survivors: int,
                  out_w: int, bound_w: int):
-    """Binning + survivor/bound emission from an accumulated score tile
-    (shared by the single-chunk fast path and the multi-chunk tail;
-    ``ti`` is the db-tile program id, hoisted by the caller because
-    ``pl.program_id`` is unavailable inside a ``pl.when`` branch in
-    interpret mode)."""
+    """Binning + survivor/bound selection from an accumulated score
+    tile: returns ``(cand_d, cand_i, bounds)`` arrays for the caller to
+    write (the tiled kernel stores them to its per-cell output blocks;
+    the streaming kernel stores them at the tile's dynamic column
+    offset) — ONE emitter per binning serves both db-streaming
+    strategies, which is what makes them bitwise-identical.  ``ti`` is
+    the db-tile index, hoisted by the caller because ``pl.program_id``
+    is unavailable inside a ``pl.when`` branch in interpret mode."""
     s = tn[0:1, :] - 2.0 * qt  # [BQ, T], ||q||^2 dropped
     bq = s.shape[0]
     d3 = s.reshape(bq, n_bins, bin_w)
@@ -346,21 +397,19 @@ def _emit_select(ti, qt, tn, d_ref, i_ref, b_ref, *,
             [cd, jnp.full((bq, pad), jnp.inf, jnp.float32)], axis=-1)
         ci = jnp.concatenate(
             [ci, jnp.full((bq, pad), _I32MAX, jnp.int32)], axis=-1)
-    d_ref[:] = cd
-    i_ref[:] = ci
     bpad = bound_w - n_bins
     if bpad:
         bound = jnp.concatenate(
             [bound, jnp.full((bq, bpad), jnp.inf, jnp.float32)], axis=-1)
-    # every (qi, ti) cell writes its own disjoint bounds block; the min
+    # every (qi, ti) cell owns its own disjoint bounds block; the min
     # over tiles happens in XLA after the kernel.  (The previous design
     # min-accumulated in-place across db tiles via output revisiting —
     # the mechanism under suspicion in the round-3 compiled-soundness
     # gate failure, and ~0.3 ms of HBM writes buys not depending on it.)
-    b_ref[:] = bound
+    return cd, ci, bound
 
 
-def _emit_select_grouped(ti, qt, tn, d_ref, i_ref, b_ref, *,
+def _emit_select_grouped(ti, qt, tn, *,
                          tile_n: int, bin_w: int, n_bins: int,
                          survivors: int, out_w: int, bound_w: int):
     """Lane-binned survivor/bound emission: bin b = lane b of every
@@ -403,9 +452,135 @@ def _emit_select_grouped(ti, qt, tn, d_ref, i_ref, b_ref, *,
                              ti * tile_n + gidx[j] * BIN_W + lane, _I32MAX))
     cd = jnp.concatenate(ds, axis=-1)   # [BQ, survivors * 128] = out_w
     ci = jnp.concatenate(is_, axis=-1)
-    d_ref[:] = cd
-    i_ref[:] = ci
-    b_ref[:] = vals[survivors]          # [BQ, 128] = bound_w
+    return cd, ci, vals[survivors]      # bound: [BQ, 128] = bound_w
+
+
+def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
+                   survivors: int, out_w: int, bound_w: int, n_tiles: int,
+                   nd: int, precision: str, binning: str, n_parts: int,
+                   chunk_w: int):
+    """One launch per (batch, shard): the db-side arrays stay in HBM and
+    stream tile-by-tile through TWO VMEM scratch slots via explicit
+    async copies — tile i+1's HBM->VMEM copy overlaps tile i's MXU
+    distance pass and VPU select (the double buffer).  The running
+    (distance, index) candidate list lives in the VMEM-resident output
+    block across the whole tile loop and flushes to HBM once per query
+    block; each tile's survivors land at the tile's column offset, so
+    the output layout (and every value in it — the shared emitters do
+    the selection) is bitwise-identical to the tiled kernel's.
+
+    Ref layout (inputs, then outputs, then scratch):
+      [db part HBM refs x n_parts]  bf16x3: th, tl | bf16x3f: t3 | else: db
+      tn HBM ref                    [8, n_tiles * tile_n] row norms
+      d_ref, i_ref, b_ref           full-width VMEM output blocks
+      [part VMEM buffers x n_parts] (2, tile_n, chunk_w) double buffers
+      tn VMEM buffer                (2, 8, tile_n)
+      sem                           DMA semaphores (2, n_parts + 1)
+    """
+    parts_hbm = refs[:n_parts]
+    tn_hbm = refs[n_parts]
+    d_ref, i_ref, b_ref = refs[n_parts + 1 : n_parts + 4]
+    part_bufs = refs[n_parts + 4 : 2 * n_parts + 4]
+    tn_buf = refs[2 * n_parts + 4]
+    sem = refs[2 * n_parts + 5]
+    q = q_ref[:]
+    dn = (((1,), (1,)), ((), ()))
+    emit = _emit_select_grouped if binning == "grouped" else _emit_select
+
+    def part_dma(j, ti, c, slot):
+        return pltpu.make_async_copy(
+            parts_hbm[j].at[pl.ds(ti * tile_n, tile_n),
+                            pl.ds(c * chunk_w, chunk_w)],
+            part_bufs[j].at[slot],
+            sem.at[slot, j],
+        )
+
+    def tn_dma(ti, slot):
+        return pltpu.make_async_copy(
+            tn_hbm.at[:, pl.ds(ti * tile_n, tile_n)],
+            tn_buf.at[slot],
+            sem.at[slot, n_parts],
+        )
+
+    def start_parts(ti, c, slot):
+        for j in range(n_parts):
+            part_dma(j, ti, c, slot).start()
+
+    def chunk_qt(c, bufs):
+        """[BQ, tile_n] f32 score contribution of dim chunk ``c`` —
+        the same per-chunk arithmetic as the tiled kernel body (the
+        query chunk is a static slice of the full-dim block here where
+        the tiled kernel's BlockSpec sliced it; the cast/dot sequence
+        is identical, which the bitwise contract rests on)."""
+        qc = q[:, c * DIM_CHUNK : (c + 1) * DIM_CHUNK]
+        if precision == "bf16x3":
+            th, tl = bufs
+            qh = qc.astype(jnp.bfloat16)
+            ql = (qc - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+            return (lax.dot_general(qh, th, dn,
+                                    preferred_element_type=jnp.float32)
+                    + lax.dot_general(qh, tl, dn,
+                                      preferred_element_type=jnp.float32)
+                    + lax.dot_general(ql, th, dn,
+                                      preferred_element_type=jnp.float32))
+        if precision == "bf16x3f":
+            t3, = bufs
+            qh = qc.astype(jnp.bfloat16)
+            ql = (qc - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+            q3 = jnp.concatenate([qh, qh, ql], axis=1)
+            return lax.dot_general(q3, t3, dn,
+                                   preferred_element_type=jnp.float32)
+        t, = bufs
+        prec = (lax.Precision.HIGHEST if precision == "highest"
+                else lax.Precision.DEFAULT)
+        return lax.dot_general(qc, t, dn,
+                               preferred_element_type=jnp.float32,
+                               precision=prec)
+
+    # warm-up: tile 0's first chunk + row norms start before the loop
+    start_parts(0, 0, 0)
+    tn_dma(0, 0).start()
+
+    def tile_body(ti, carry):
+        qt = None
+        for c in range(nd):  # nd is static: the chunk loop unrolls
+            slot = (ti * nd + c) % 2
+            for j in range(n_parts):
+                part_dma(j, ti, c, slot).wait()
+            # prefetch the NEXT step while this chunk computes: the
+            # other slot's previous occupant was consumed last step
+            nxt = (ti * nd + c + 1) % 2
+            if c + 1 < nd:
+                start_parts(ti, c + 1, nxt)
+            else:
+                @pl.when(ti + 1 < n_tiles)
+                def _():
+                    start_parts(ti + 1, 0, nxt)
+                    tn_dma(ti + 1, (ti + 1) % 2).start()
+            qt_c = chunk_qt(c, [part_bufs[j][slot] for j in range(n_parts)])
+            # same accumulation order as the tiled kernel's qt scratch
+            qt = qt_c if qt is None else qt + qt_c
+        tn_dma(ti, ti % 2).wait()
+        cd, ci, bound = emit(
+            ti, qt, tn_buf[ti % 2], tile_n=tile_n, bin_w=bin_w,
+            n_bins=n_bins, survivors=survivors, out_w=out_w,
+            bound_w=bound_w)
+        off = pl.multiple_of(ti * out_w, out_w)
+        d_ref[:, pl.ds(off, out_w)] = cd
+        i_ref[:, pl.ds(off, out_w)] = ci
+        boff = pl.multiple_of(ti * bound_w, bound_w)
+        b_ref[:, pl.ds(boff, bound_w)] = bound
+        return carry
+
+    lax.fori_loop(0, n_tiles, tile_body, 0)
+
+
+def _compiler_params(**kwargs):
+    """pltpu.CompilerParams across jax versions (0.4.x ships it as
+    TPUCompilerParams); only reached on compiled (non-interpret)
+    builds."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
 
 
 def _pad_axis(x, multiple: int, axis: int, fill: float = 0.0):
@@ -423,7 +598,7 @@ def _on_tpu() -> bool:
 @functools.partial(
     jax.jit, static_argnames=("block_q", "tile_n", "bin_w", "survivors",
                               "precision", "interpret", "binning",
-                              "grid_order")
+                              "grid_order", "kernel")
 )
 def _bin_candidates(
     queries: jax.Array,
@@ -437,6 +612,7 @@ def _bin_candidates(
     interpret: bool,
     binning: str = "grouped",
     grid_order: str = "query_major",
+    kernel: str = "tiled",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Kernel launch on padded shapes.  Returns
 
@@ -448,7 +624,9 @@ def _bin_candidates(
 
     W = n_tiles * out_w (survivors per bin, lane-padded per tile).  Zero
     dim-padding preserves scores exactly; PAD_VAL row-padding scores
-    ~1e36 so pads never surface (module docstring)."""
+    ~1e36 so pads never surface (module docstring).  ``kernel`` picks
+    the db-streaming strategy (KERNELS); outputs are bitwise-identical
+    across strategies."""
     queries = _pad_axis(queries.astype(jnp.float32), block_q, 0)
     queries = _pad_axis(queries, DIM_CHUNK, 1)
     db = _pad_axis(db.astype(jnp.float32), tile_n, 0, fill=PAD_VAL)
@@ -468,8 +646,51 @@ def _bin_candidates(
         raise ValueError(f"precision {precision!r} not in {PRECISIONS}")
     if grid_order not in GRID_ORDERS:
         raise ValueError(f"grid_order {grid_order!r} not in {GRID_ORDERS}")
+    if kernel not in KERNELS:
+        raise ValueError(f"kernel {kernel!r} not in {KERNELS}")
+    if kernel == "streaming" and grid_order != "query_major":
+        # the streaming launch has no db grid axis to reorder: its tile
+        # loop is inherently query-major.  Refuse rather than silently
+        # ignore the knob (the autotuner enumerates valid combinations).
+        raise ValueError(
+            "kernel='streaming' streams the db inside one launch; "
+            "grid_order='db_major' does not apply")
+    if precision in ("bf16x3", "bf16x3f"):
+        # the high/low split of the db happens ONCE in XLA; the kernel
+        # streams bf16 tiles and never re-derives them per query block
+        th = db.astype(jnp.bfloat16)
+        tl = (db - th.astype(jnp.float32)).astype(jnp.bfloat16)
+        if precision == "bf16x3":
+            db_inputs = [th, tl]
+            chunk_w = DIM_CHUNK
+        else:
+            # per dim chunk c the fused contraction reads [th_c|tl_c|th_c]
+            th3 = th.reshape(db.shape[0], nd, DIM_CHUNK)
+            tl3 = tl.reshape(db.shape[0], nd, DIM_CHUNK)
+            t3 = jnp.concatenate([th3, tl3, th3], axis=2).reshape(
+                db.shape[0], nd * 3 * DIM_CHUNK)
+            db_inputs = [t3]
+            chunk_w = 3 * DIM_CHUNK
+    else:
+        db_inputs = [db]
+        chunk_w = DIM_CHUNK
+    out_shape = [
+        jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.float32),
+        jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.int32),
+        jax.ShapeDtypeStruct((qp, n_tiles * bound_w), jnp.float32),
+    ]
+
+    if kernel == "streaming":
+        return _stream_call(
+            queries, db_inputs, tnorm, out_shape, qp=qp, dim=dim,
+            block_q=block_q, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
+            survivors=survivors, out_w=out_w, bound_w=bound_w,
+            n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
+            chunk_w=chunk_w, interpret=interpret,
+        )
+
     db_major = grid_order == "db_major"
-    kernel = functools.partial(
+    body = functools.partial(
         _kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
         survivors=survivors, out_w=out_w, bound_w=bound_w, nd=nd,
         precision=precision, binning=binning,
@@ -497,7 +718,7 @@ def _bin_candidates(
         # v5e has 128 MB of VMEM, and a geometry that genuinely
         # overflows still fails at compile time, never silently.
         score_mb = block_q * tile_n * 4 // (1024 * 1024)
-        kwargs["compiler_params"] = pltpu.CompilerParams(
+        kwargs["compiler_params"] = _compiler_params(
             # db_major: the outer axis is the db tile, whose input block
             # is revisited across inner steps — it must stay sequential
             dimension_semantics=(
@@ -505,34 +726,9 @@ def _bin_candidates(
                 else ("parallel", "arbitrary", "arbitrary")),
             vmem_limit_bytes=max(64, 3 * score_mb + 24) * 1024 * 1024,
         )
-    if precision in ("bf16x3", "bf16x3f"):
-        # the high/low split of the db happens ONCE in XLA; the kernel
-        # streams bf16 tiles and never re-derives them per query block
-        th = db.astype(jnp.bfloat16)
-        tl = (db - th.astype(jnp.float32)).astype(jnp.bfloat16)
-        if precision == "bf16x3":
-            db_inputs = [th, tl]
-            db_specs = [
-                pl.BlockSpec((tile_n, DIM_CHUNK), t_idx),
-                pl.BlockSpec((tile_n, DIM_CHUNK), t_idx),
-            ]
-        else:
-            # per dim chunk c the fused contraction reads [th_c|tl_c|th_c]
-            th3 = th.reshape(db.shape[0], nd, DIM_CHUNK)
-            tl3 = tl.reshape(db.shape[0], nd, DIM_CHUNK)
-            t3 = jnp.concatenate([th3, tl3, th3], axis=2).reshape(
-                db.shape[0], nd * 3 * DIM_CHUNK)
-            db_inputs = [t3]
-            db_specs = [
-                pl.BlockSpec((tile_n, 3 * DIM_CHUNK), t_idx),
-            ]
-    else:
-        db_inputs = [db]
-        db_specs = [
-            pl.BlockSpec((tile_n, DIM_CHUNK), t_idx),
-        ]
+    db_specs = [pl.BlockSpec((tile_n, chunk_w), t_idx) for _ in db_inputs]
     return pl.pallas_call(
-        kernel,
+        body,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_q, DIM_CHUNK), q_idx),
@@ -544,11 +740,7 @@ def _bin_candidates(
             pl.BlockSpec((block_q, out_w), o_idx),
             pl.BlockSpec((block_q, bound_w), o_idx),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.float32),
-            jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.int32),
-            jax.ShapeDtypeStruct((qp, n_tiles * bound_w), jnp.float32),
-        ],
+        out_shape=out_shape,
         # the qt accumulation scratch is only touched when dim spans
         # multiple chunks; at dim <= 128 (the headline shape) skipping it
         # returns VMEM to the pipeline
@@ -560,11 +752,66 @@ def _bin_candidates(
     )(queries, *db_inputs, tnorm)
 
 
+def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
+                 tile_n, bin_w, n_bins, survivors, out_w, bound_w, n_tiles,
+                 nd, precision, binning, chunk_w, interpret):
+    """The streaming ``pallas_call``: grid over query blocks only, db
+    parts + row norms left in compiler-chosen (HBM) memory and streamed
+    by the kernel's own double-buffered DMA loop (``_stream_kernel``)."""
+    n_parts = len(db_inputs)
+    body = functools.partial(
+        _stream_kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
+        survivors=survivors, out_w=out_w, bound_w=bound_w,
+        n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
+        n_parts=n_parts, chunk_w=chunk_w,
+    )
+    any_space = getattr(pltpu, "ANY", None) or pltpu.TPUMemorySpace.ANY
+    part_dtype = db_inputs[0].dtype
+    kwargs = {}
+    if not interpret:
+        # VMEM high-water: the full-width output blocks (the carried
+        # candidate list), the double-buffered db/norm slots, and the
+        # live [block_q, tile_n] score tile.  A geometry that genuinely
+        # overflows the chip still fails at compile time, never silently.
+        out_b = block_q * (2 * n_tiles * out_w + n_tiles * bound_w) * 4
+        buf_b = 2 * (n_parts * tile_n * chunk_w * part_dtype.itemsize
+                     + 8 * tile_n * 4)
+        score_b = block_q * tile_n * 4
+        budget = min(120, (out_b + buf_b + 2 * score_b) // 2 ** 20 + 32)
+        kwargs["compiler_params"] = _compiler_params(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=budget * 1024 * 1024,
+        )
+    return pl.pallas_call(
+        body,
+        grid=(qp // block_q,),
+        in_specs=[
+            pl.BlockSpec((block_q, dim), lambda q: (q, 0)),
+            *[pl.BlockSpec(memory_space=any_space) for _ in db_inputs],
+            pl.BlockSpec(memory_space=any_space),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_q, n_tiles * out_w), lambda q: (q, 0)),
+            pl.BlockSpec((block_q, n_tiles * out_w), lambda q: (q, 0)),
+            pl.BlockSpec((block_q, n_tiles * bound_w), lambda q: (q, 0)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=[
+            *[pltpu.VMEM((2, tile_n, chunk_w), part_dtype)
+              for _ in db_inputs],
+            pltpu.VMEM((2, 8, tile_n), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, n_parts + 1)),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(queries, *db_inputs, tnorm)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("m", "tile_n", "block_q", "bin_w", "survivors",
                      "precision", "final_select", "interpret", "binning",
-                     "final_recall_target", "grid_order"),
+                     "final_recall_target", "grid_order", "kernel"),
 )
 def local_certified_candidates(
     q: jax.Array,
@@ -581,6 +828,7 @@ def local_certified_candidates(
     binning: str = "grouped",
     final_recall_target: Optional[float] = None,
     grid_order: str = "query_major",
+    kernel: str = "tiled",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole device-side certified coarse pass against one db (shard):
 
@@ -614,6 +862,7 @@ def local_certified_candidates(
         q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
         bin_w=bin_w, survivors=survivors, precision=precision,
         interpret=interpret, binning=binning, grid_order=grid_order,
+        kernel=kernel,
     )
     n_q = q.shape[0]
     cd, ci, bounds = cd[:n_q], ci[:n_q], bounds[:n_q]
@@ -752,6 +1001,7 @@ def knn_search_pallas(
     binning: str = "grouped",
     final_recall_target: Optional[float] = None,
     grid_order: str = "query_major",
+    kernel: str = "tiled",
 ) -> Tuple[np.ndarray, np.ndarray, dict]:
     """Certified-exact KNN in ONE database pass on a single-device mesh:
     fused kernel coarse select -> device rank -> exclusion-bound
@@ -785,7 +1035,7 @@ def knn_search_pallas(
         bin_w=bin_w, survivors=survivors, block_q=block_q,
         final_select=final_select,
         binning=binning, final_recall_target=final_recall_target,
-        grid_order=grid_order,
+        grid_order=grid_order, kernel=kernel,
     )
 
 

@@ -7,6 +7,13 @@ distance matrix, and for databases too large to materialize a full |Q|x|T|
 distance matrix in HBM, a ``lax.scan`` over train tiles that carries a
 running top-k (the TPU-KNN-paper-style streaming merge; SURVEY.md §7 step 5).
 
+The Pallas coarse path has its own in-kernel alternative to the scan
+merge here: ``ops.pallas_knn``'s ``kernel="streaming"`` carries the
+running per-bin candidate list across train tiles inside ONE kernel
+launch (double-buffered HBM->VMEM streaming) instead of round-tripping
+per-tile partials to this module's merge — the lexicographic
+(distance, index) contract below is shared by both.
+
 Tie-breaking: the reference's ``std::sort`` with ``Comp`` (knn_mpi.cpp:24-31)
 leaves the order of equal distances unspecified.  We define it: ties go to
 the **lower train index** — i.e. the k-nearest set is the lexicographic

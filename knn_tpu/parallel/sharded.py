@@ -701,13 +701,15 @@ class ShardedKNN:
     def search_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None, tile_n: Optional[int] = None,
-        precision: str = "bf16x3", return_distances: bool = True,
+        precision: Optional[str] = None, return_distances: bool = True,
         bin_w: Optional[int] = None, survivors: Optional[int] = None,
-        block_q: Optional[int] = None, final_select: str = "exact",
+        block_q: Optional[int] = None, final_select: Optional[str] = None,
         recall_target: Optional[float] = None,
-        binning: str = "grouped",
+        binning: Optional[str] = None,
         final_recall_target: Optional[float] = None,
-        grid_order: str = "query_major",
+        grid_order: Optional[str] = None,
+        kernel: Optional[str] = None,
+        tune_cache: Optional[str] = None,
         return_sqrt: bool = False,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
@@ -748,16 +750,22 @@ class ShardedKNN:
         device work of batches > b.  None = one batch (all queries at
         once).
 
-        Pallas-selector tuning knobs (defaults = measured v5e winners):
-        ``bin_w`` (lane width of a kernel bin), ``survivors`` (candidates
-        kept per bin; the candidate array the final select scans is
-        ``~ n_rows * survivors / bin_w`` wide), ``final_select``
-        ("exact" = full top-(m+2) | "approx" = hardware ApproxTopK with
-        the exclusion value restored exactly — cheaper, never unsound,
-        misses surface as fallbacks).  ``recall_target`` tunes the
+        Pallas-selector tuning knobs (``tile_n``, ``block_q``, ``bin_w``,
+        ``survivors``, ``precision``, ``final_select``, ``binning``,
+        ``grid_order``, ``final_recall_target``, ``kernel``): any knob
+        left at None resolves through ``knn_tpu.tuning.resolve`` — the
+        persisted autotuner winner for this exact
+        ``(device_kind, n, d, k, metric, dtype)`` when one exists
+        (``python -m knn_tpu.cli tune``; ``tune_cache`` overrides the
+        cache file), else the library defaults — and EXPLICIT values
+        always win over both.  ``kernel`` picks the db-streaming
+        strategy (ops.pallas_knn.KERNELS: "tiled" | the one-launch
+        double-buffered "streaming").  ``recall_target`` tunes the
         counted "approx" selector's per-element ApproxTopK recall
         (None = its default 0.95; raise toward 0.9999 with a wider
-        ``margin`` to push the fallback rate below 1%).
+        ``margin`` to push the fallback rate below 1%).  The resolved
+        knob set and its provenance land in
+        ``stats["pallas_knobs"]`` / ``stats["tuning"]``.
         """
         if self.metric == "cosine":
             # runs the l2 certificate on unit vectors (db rows were
@@ -812,15 +820,28 @@ class ShardedKNN:
         d = np.empty((n_q, self.k))
         i = np.empty((n_q, self.k), dtype=np.int64)
 
+        tune_info = None
         if selector == "pallas":
+            # ONE knob-resolution home (knn_tpu.tuning): explicit args >
+            # the persisted autotuner winner for this placement's shape >
+            # library defaults
+            from knn_tpu import tuning
+
+            knobs, tune_info = tuning.resolve_full(
+                self.n_train, self._tp.shape[1], self.k,
+                metric=cert_metric, dtype=self._dtype_key,
+                cache_path=tune_cache,
+                overrides=dict(
+                    tile_n=tile_n, precision=precision, bin_w=bin_w,
+                    survivors=survivors, block_q=block_q,
+                    final_select=final_select, binning=binning,
+                    final_recall_target=final_recall_target,
+                    grid_order=grid_order, kernel=kernel,
+                ),
+            )
             bad, n_corrected = self._certify_pallas(
                 batches, bs, m, d, i, q_np, db_np, db_norm_max,
-                tile_n=tile_n, precision=precision,
-                want_distances=return_distances,
-                bin_w=bin_w, survivors=survivors, block_q=block_q,
-                final_select=final_select, binning=binning,
-                final_recall_target=final_recall_target,
-                grid_order=grid_order,
+                want_distances=return_distances, **knobs,
             )
         else:
             bad = self._certify_counted(
@@ -856,6 +877,8 @@ class ShardedKNN:
         }
         if selector == "pallas":
             stats["rank_corrected_queries"] = n_corrected
+            stats["pallas_knobs"] = knobs
+            stats["tuning"] = tune_info
         if return_distances and self.metric == "cosine":
             # unit-vector squared L2 -> cosine distance values, exactly
             # (matches pairwise_cosine's 1 - similarity convention)
@@ -975,7 +998,8 @@ class ShardedKNN:
                       include_distances: bool = True,
                       binning: str = "grouped",
                       final_recall_target: Optional[float] = None,
-                      grid_order: str = "query_major"):
+                      grid_order: str = "query_major",
+                      kernel: str = "tiled"):
         """(program, m, analysis_window) for the one-pass certified
         path — the ONE home of the kernel-geometry margin cap and the
         packed-output window, shared by :meth:`_certify_pallas` and
@@ -1029,7 +1053,7 @@ class ShardedKNN:
             block_q=block_q, final_select=final_select,
             include_distances=include_distances, binning=binning,
             final_recall_target=final_recall_target,
-            grid_order=grid_order,
+            grid_order=grid_order, kernel=kernel,
         )
         return prog, m, _analysis_window(self.k, m)
 
@@ -1038,6 +1062,7 @@ class ShardedKNN:
         tile_n, precision, want_distances=True, bin_w=None, survivors=None,
         block_q=None, final_select="exact", binning="grouped",
         final_recall_target=None, grid_order="query_major",
+        kernel="tiled",
     ):
         """One-pass certificate, host side.  The device already ranked the
         candidates, flagged uncertified rows, and marked near-tie pairs
@@ -1057,7 +1082,8 @@ class ShardedKNN:
                                         include_distances=want_distances,
                                         binning=binning,
                                         final_recall_target=final_recall_target,
-                                        grid_order=grid_order)
+                                        grid_order=grid_order,
+                                        kernel=kernel)
 
         # stage 1: dispatch every batch (async on device)
         norm_op = np.float32(db_norm_max)
@@ -1093,16 +1119,20 @@ class ShardedKNN:
     def predict_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None, tile_n: Optional[int] = None,
-        precision: str = "bf16x3",
+        precision: Optional[str] = None, kernel: Optional[str] = None,
+        tune_cache: Optional[str] = None,
     ):
         """Certified-exact classification: exact neighbor sets from
         :meth:`search_certified`, then the reference vote (ops.vote).
-        Returns (labels [Q] int32, stats)."""
+        Returns (labels [Q] int32, stats).  Kernel knobs left at None
+        resolve through ``knn_tpu.tuning`` exactly like
+        :meth:`search_certified`."""
         if self._labels is None:
             raise RuntimeError("ShardedKNN built without labels; predict unavailable")
         _, idx, stats = self.search_certified(
             queries, margin=margin, selector=selector, batch_size=batch_size,
-            tile_n=tile_n, precision=precision,
+            tile_n=tile_n, precision=precision, kernel=kernel,
+            tune_cache=tune_cache,
             return_distances=False,  # labels only: skip the d transfer
         )
         labels_host = np.asarray(self._labels)
@@ -1226,6 +1256,7 @@ def _pallas_certified_program(
     include_distances: bool = True, binning: str = "grouped",
     final_recall_target: Optional[float] = None,
     grid_order: str = "query_major",
+    kernel: str = "tiled",
 ):
     """ONE-pass sharded self-certifying coarse select + device rank +
     device certificate (ops.pallas_knn.local_certified_candidates per
@@ -1275,7 +1306,7 @@ def _pallas_certified_program(
             q, t, m, tile_n=eff_tile, bin_w=eff_bin, survivors=survivors,
             block_q=eff_bq, final_select=final_select, precision=precision,
             binning=binning, final_recall_target=final_recall_target,
-            grid_order=grid_order,
+            grid_order=grid_order, kernel=kernel,
         )
         db_idx = lax.axis_index(DB_AXIS)
         gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,

@@ -169,10 +169,16 @@ def _run_jax(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, val,
                 # real rows only: zero-pad queries would pollute the
                 # certificate stats (and can spuriously fall back)
                 labels_out, stats = program.predict_certified(
-                    chunk[:take], selector=cfg.selector
+                    chunk[:take], selector=cfg.selector,
+                    tune_cache=cfg.tune_cache,
                 )
                 for key, v in stats.items():  # incl. host_exact_queries
-                    certified_stats[key] = certified_stats.get(key, 0) + v
+                    if isinstance(v, (int, np.integer)):
+                        certified_stats[key] = certified_stats.get(key, 0) + v
+                    else:
+                        # non-additive observability (the resolved
+                        # pallas_knobs / tuning provenance): keep as-is
+                        certified_stats[key] = v
                 out.append(np.asarray(labels_out))
             elif engine is not None:
                 # the engine pads to its bucket ladder itself; the raw

@@ -361,11 +361,40 @@ class ServingEngine:
         with self._lock:
             self._latencies_s.append(seconds)
 
+    def _tuning_info(self) -> Optional[dict]:
+        """Resolved kernel knobs + provenance for this placement's shape
+        (knn_tpu.tuning — the same resolve call search_certified makes),
+        so serving observability shows whether a persisted autotuner
+        winner or the library defaults would drive the certified path
+        on this engine's placement.  Memoized; never fatal (tuning is
+        observability here, not a dispatch dependency)."""
+        cached = getattr(self, "_tuning_memo", False)
+        if cached is not False:
+            return cached
+        try:
+            from knn_tpu import tuning
+
+            p = self.program
+            # the same key search_certified resolves with: the cosine
+            # certificate runs on unit vectors under the l2 kernel, so
+            # its winners are keyed (and must be looked up) as l2
+            cert_metric = "l2" if p.metric == "cosine" else p.metric
+            knobs, info = tuning.resolve_full(
+                p.n_train, self._dim, self.k, metric=cert_metric,
+                dtype=p._dtype_key)
+            memo = {"resolved_knobs": knobs, **info}
+        except Exception:  # pragma: no cover - backend-less stats call
+            memo = None
+        self._tuning_memo = memo
+        return memo
+
     def stats(self) -> dict:
         """Compile/dispatch accounting + request latency percentiles —
         the serving metrics JobResult/bench surface."""
+        tuning_info = self._tuning_info()
         with self._lock:
             return {
+                **({"tuning": tuning_info} if tuning_info else {}),
                 "buckets": list(self.buckets),
                 "compile_count": int(sum(self._compiles.values())),
                 "executables": len(self._execs),

@@ -1,0 +1,47 @@
+"""Persistent kernel autotuning (the knob search that used to die with
+each TPU session, made reproducible and cached).
+
+Every Pallas-kernel knob consumer resolves through ONE call::
+
+    from knn_tpu import tuning
+    knobs = tuning.resolve(n, d, k, metric="l2", dtype=None,
+                           overrides={"tile_n": explicit_or_None, ...})
+
+Precedence: explicit overrides > the persisted winner for this exact
+``(device_kind, n, d, k, metric, dtype)`` > library defaults.  Winners
+come from :func:`autotune` (``python -m knn_tpu.cli tune`` on a TPU
+session) and live in one JSON file (:mod:`knn_tpu.tuning.cache`;
+``KNN_TPU_TUNE_CACHE`` overrides the location).  Candidates must pass a
+bitwise end-result gate against the reference grouped kernel before
+they may win — a fast wrong kernel can never be selected.
+"""
+
+from knn_tpu.tuning.autotune import (
+    DEFAULT_KNOBS,
+    autotune,
+    counters,
+    knob_grid,
+    reset_counters,
+    resolve,
+    resolve_full,
+)
+from knn_tpu.tuning.cache import (
+    CACHE_ENV,
+    TuneCache,
+    cache_key,
+    default_cache_path,
+)
+
+__all__ = [
+    "DEFAULT_KNOBS",
+    "autotune",
+    "counters",
+    "knob_grid",
+    "reset_counters",
+    "resolve",
+    "resolve_full",
+    "CACHE_ENV",
+    "TuneCache",
+    "cache_key",
+    "default_cache_path",
+]

@@ -76,6 +76,12 @@ class JobConfig:
     #: request may wait to be coalesced with others.  Echoed into the
     #: serving metrics; only a concurrent-request queue consults it.
     max_wait_ms: float = 2.0
+    #: autotuner winner-cache file for the certified pallas selector
+    #: (knn_tpu.tuning; populate with `python -m knn_tpu.cli tune`).
+    #: None = $KNN_TPU_TUNE_CACHE or the user default path; the job's
+    #: kernel knobs resolve from it through tuning.resolve, and the
+    #: resolved set lands in metrics()["certified_stats"]["pallas_knobs"].
+    tune_cache: Optional[str] = None
     # --- native backend knobs ---
     num_threads: int = 0  # 0 = hardware concurrency
 

@@ -9,12 +9,39 @@ window (a hardcoded round rewrites history once the round is frozen; a
 newest-file default does the same at the round boundary before the new
 round's file exists).  Seeds from the previous round's curated file so
 configs that did not re-measure this round survive with their
-provenance intact."""
+provenance intact.
+
+PROVENANCE CONTRACT: every curated line carries three fields —
+``measured_round`` (the round whose session produced the measurement),
+``measured_at_commit`` (the git commit the measuring run carried; the
+bench stamps its own lines, pre-provenance lines backfill
+"unknown(pre-provenance)") and ``stale`` (true when measured_round <
+the round being curated, i.e. the number was republished from an
+earlier round rather than re-measured).  The round-5 verdict flagged
+GloVe/GIST republishing round-3 numbers verbatim with no marker; this
+script REFUSES to write any line missing the fields, so an unmarked
+republication can never happen again."""
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: provenance every written line must carry (stale is recomputed below)
+PROVENANCE_FIELDS = ("measured_round", "measured_at_commit")
+
+
+def head_commit() -> str:
+    """Short git HEAD of the repo (the commit the freshly-curated
+    session lines were measured at), or "unknown" outside a checkout."""
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10)
+        return r.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
 
 try:
     _r = int(sys.argv[1])
@@ -47,9 +74,17 @@ def rank(rec):
 
 best = {}
 order = []
+_HEAD = head_commit()
 
 
-def feed(path):
+def feed(path, source_round, fresh=False):
+    """Feed one file's lines into the curation.  ``source_round`` is the
+    round the file's UNSTAMPED lines were measured in (this round for
+    session lines, the seed file's round for carried-over curations);
+    lines already carrying provenance keep it.  ``fresh`` lines (this
+    round's session measurements) stamp the current git HEAD; anything
+    older backfills "unknown(pre-provenance)" — an honest marker beats
+    a fabricated commit."""
     if not os.path.exists(path):
         return
     for line in open(path):
@@ -60,6 +95,10 @@ def feed(path):
         cfg = rec.get("metric")
         if not cfg or rec.get("value") is None:
             continue
+        rec.setdefault("measured_round", source_round)
+        if "measured_at_commit" not in rec:
+            rec["measured_at_commit"] = (
+                _HEAD if fresh else "unknown(pre-provenance)")
         if cfg not in best:
             order.append(cfg)
             best[cfg] = rec
@@ -95,9 +134,26 @@ def feed(path):
 # current curation (configs whose session lines predate
 # tpu_bench_lines.jsonl's rotation must survive a refresh), then let
 # fresher session lines supersede them
-feed(SEED)
-feed(DST)
-feed(SRC)
+feed(SEED, _r - 1)
+# UNSTAMPED lines already sitting in this round's curated file are of
+# unknowable measurement round (pre-provenance curations mixed rounds —
+# exactly the flagged GloVe/GIST case), so they backfill as LAST round:
+# over-claiming staleness is recoverable (a genuinely fresh line re-feeds
+# from SRC below with its round-_r stamp), over-claiming freshness is
+# the bug this contract exists to kill.  Lines stamped by an earlier
+# refresh keep their provenance verbatim (setdefault).
+feed(DST, _r - 1)
+feed(SRC, _r, fresh=True)
+
+for cfg, rec in best.items():
+    missing = [fld for fld in PROVENANCE_FIELDS if fld not in rec]
+    if missing:  # unreachable via feed(); guards future edits
+        sys.exit(f"refusing to emit curated line for {cfg}: missing "
+                 f"provenance field(s) {missing}")
+    # stale is a judgment RELATIVE to the round being curated, so it is
+    # recomputed on every refresh: a number measured in an earlier
+    # round and republished here must say so on its face
+    rec["stale"] = rec["measured_round"] < _r
 
 with open(DST, "w") as f:
     for cfg in order:
@@ -105,4 +161,6 @@ with open(DST, "w") as f:
         r = best[cfg]
         print(f"{cfg}: value={r['value']} mode={r.get('mode')} "
               f"backend={r.get('backend')} "
-              f"gate={r.get('pallas_gate_ok')} recall={r.get('recall_at_k')}")
+              f"gate={r.get('pallas_gate_ok')} recall={r.get('recall_at_k')} "
+              f"round={r['measured_round']}"
+              f"{' STALE' if r['stale'] else ''}")

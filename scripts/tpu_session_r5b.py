@@ -19,6 +19,15 @@ combined with the tile that wins the final select.  This session:
      and smaller batches pipeline d2h under later batches' compute.
 
 Artifacts: appends to tpu_bench_lines.jsonl, same formats as r5a.
+
+SUPERSEDED for knob search: the hand grid below is exactly what
+``python -m knn_tpu.cli tune --n 1000000 --dim 128 --k 100 --grid
+standard`` now runs reproducibly (knn_tpu.tuning) — including the
+untried t32768×bq256 cross, the bf16x3f precision, and the new
+streaming kernel — with every candidate bitwise-gated and the winner
+persisted so later bench runs resolve it with zero re-timing.  Use the
+tuner on the next silicon window; this script stays as the r5b probe
+record.
 """
 
 import json

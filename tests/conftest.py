@@ -11,8 +11,14 @@ there, and config updates alone don't cover fresh subprocesses):
 """
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# isolate the autotuner's persisted winner cache: a developer machine's
+# real ~/.cache/knn_tpu/autotune.json must never steer test kernels
+# (tests that exercise the cache pass explicit paths / their own env)
+os.environ["KNN_TPU_TUNE_CACHE"] = os.path.join(
+    tempfile.mkdtemp(prefix="knn_tpu_test_tune_"), "autotune.json")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,161 @@
+"""The double-buffered streaming kernel (ops.pallas_knn kernel="streaming")
+in interpret mode: bitwise equality against the tiled grouped kernel at
+every output level — raw bin candidates, the certified candidate stage,
+and the end-to-end certified search — across tile-boundary cases (n not
+divisible by tile_n, true neighbors straddling a tile edge, duplicate
+distances exercising the lexicographic tie-break), plus the float64
+direct-difference oracle (the pairwise_sq_l2_direct semantics in fp64).
+Bitwise equality is the whole contract: the streaming pipeline changes
+HOW the db reaches VMEM (explicit double-buffered DMA, one launch per
+batch/shard), never WHAT is computed.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from knn_tpu.ops.pallas_knn import (
+    BIN_W,
+    _bin_candidates,
+    kernel_launches_per_batch,
+    knn_search_pallas,
+    local_certified_candidates,
+)
+from tests.oracles import sq_l2, topk_lowindex
+
+
+def _oracle(db, queries, k):
+    return topk_lowindex(sq_l2(queries, db), k)
+
+
+@pytest.mark.parametrize("dim", [24, 300])
+@pytest.mark.parametrize("precision,binning", [
+    ("bf16x3", "grouped"), ("bf16x3f", "grouped"), ("highest", "grouped"),
+    ("bf16x3", "lane"), ("default", "grouped"),
+])
+def test_streaming_bitwise_equals_tiled_bin_candidates(rng, dim, precision,
+                                                       binning):
+    # raw kernel outputs (candidates, indices, per-tile bounds) across
+    # uneven tile counts (n % tile_n != 0 -> PAD_VAL padding) and both
+    # single- and multi-chunk dims (300 spans 3 DIM_CHUNKs)
+    db = rng.normal(size=(3 * BIN_W + 41, dim)).astype(np.float32) * 10
+    queries = rng.normal(size=(11, dim)).astype(np.float32) * 10
+    outs = {}
+    for kern in ("tiled", "streaming"):
+        outs[kern] = _bin_candidates(
+            jnp.asarray(queries), jnp.asarray(db), block_q=8,
+            tile_n=2 * BIN_W, bin_w=BIN_W, survivors=2,
+            precision=precision, interpret=True, binning=binning,
+            kernel=kern)
+    for a, b in zip(outs["tiled"], outs["streaming"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("n_rows", [
+    2 * BIN_W,          # exactly one tile
+    2 * BIN_W + 1,      # one row past a tile edge
+    5 * BIN_W + 60,     # several tiles, ragged tail
+])
+def test_streaming_bitwise_equals_tiled_certified_stage(rng, n_rows):
+    # the full certified candidate stage (kernel + final select + f32
+    # rescore): d32, idx, AND the exclusion bound must agree bitwise
+    db = rng.normal(size=(n_rows, 24)).astype(np.float32) * 10
+    queries = rng.normal(size=(7, 24)).astype(np.float32) * 10
+    outs = {}
+    for kern in ("tiled", "streaming"):
+        outs[kern] = local_certified_candidates(
+            jnp.asarray(queries), jnp.asarray(db), m=13, block_q=8,
+            tile_n=2 * BIN_W, interpret=True, kernel=kern)
+    for a, b in zip(outs["tiled"], outs["streaming"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streaming_k_spanning_tile_edge_matches_oracle(rng):
+    # plant the true top-k STRADDLING a tile boundary (last rows of tile
+    # 0, first rows of tile 1): the carried candidate list must merge
+    # across the in-kernel tile loop exactly like the tiled path's XLA
+    # merge
+    dim, k, tile_n = 16, 8, 2 * BIN_W
+    db = rng.normal(size=(4 * BIN_W, dim)).astype(np.float32) * 50
+    query = rng.normal(size=(1, dim)).astype(np.float32)
+    hot = [tile_n - 4 + j for j in range(4)] + [tile_n + j for j in range(4)]
+    for j, r in enumerate(hot):
+        db[r] = query[0] + (j + 1) * 1e-3
+    ref_d, ref_i = _oracle(db, query, k)
+    for kern in ("tiled", "streaming"):
+        d, i, _ = knn_search_pallas(query, db, k, tile_n=tile_n, margin=6,
+                                    kernel=kern)
+        np.testing.assert_array_equal(i, ref_i)
+        np.testing.assert_allclose(d, ref_d, rtol=5e-5)
+
+
+def test_streaming_duplicate_distances_lexicographic_ties(rng):
+    # duplicate rows ACROSS tiles force exact distance ties whose
+    # resolution is the documented lexicographic (distance, index)
+    # order; a query placed on a duplicated pair plus a near-tie pileup
+    # exercises the rank-correction path under both kernels
+    db = rng.normal(size=(6 * BIN_W + 31, 12)).astype(np.float32) * 20
+    db[3 * BIN_W : 3 * BIN_W + 40] = db[:40]        # tile-2 copies of tile-0 rows
+    db[5 * BIN_W : 5 * BIN_W + 10] = db[100] + 1e-3  # near-tie pileup
+    queries = rng.normal(size=(9, 12)).astype(np.float32) * 20
+    queries[0] = db[0] + 5e-4    # lands ON a cross-tile duplicate pair
+    queries[1] = db[100] + 5e-4  # lands in the pileup
+    ref_d, ref_i = _oracle(db, queries, 7)
+    results = {}
+    for kern in ("tiled", "streaming"):
+        d, i, stats = knn_search_pallas(queries, db, 7, tile_n=2 * BIN_W,
+                                        margin=8, kernel=kern)
+        np.testing.assert_array_equal(i, ref_i)
+        np.testing.assert_allclose(d, ref_d, rtol=5e-5)
+        results[kern] = (d, i, stats)
+    # and the two kernels agree bitwise END TO END — certification
+    # stats included (the knob/provenance blocks legitimately differ:
+    # they record which kernel ran)
+    np.testing.assert_array_equal(results["tiled"][0], results["streaming"][0])
+    np.testing.assert_array_equal(results["tiled"][1], results["streaming"][1])
+    strip = lambda s: {k: v for k, v in s.items()  # noqa: E731
+                       if k not in ("pallas_knobs", "tuning")}
+    assert strip(results["tiled"][2]) == strip(results["streaming"][2])
+
+
+def test_streaming_sharded_search_certified_bitwise(rng):
+    # the sharded certified pipeline with db shards: one streaming
+    # launch PER SHARD, merged across the db axis — results bitwise
+    # equal to the tiled path's
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+
+    db = rng.normal(size=(1500, 12)).astype(np.float32) * 20
+    queries = rng.normal(size=(9, 12)).astype(np.float32) * 20
+    prog = ShardedKNN(db, mesh=make_mesh(2, 4), k=5)
+    out = {}
+    for kern in ("tiled", "streaming"):
+        d, i, stats = prog.search_certified(
+            queries, selector="pallas", margin=8, tile_n=2 * BIN_W,
+            kernel=kern)
+        out[kern] = (d, i, stats)
+        assert stats["pallas_knobs"]["kernel"] == kern
+    np.testing.assert_array_equal(out["tiled"][0], out["streaming"][0])
+    np.testing.assert_array_equal(out["tiled"][1], out["streaming"][1])
+    ref_d, ref_i = _oracle(db, queries, 5)
+    np.testing.assert_array_equal(out["streaming"][1], ref_i)
+
+
+def test_streaming_rejects_db_major():
+    # the streaming launch has no db grid axis to reorder — refusing the
+    # knob beats silently ignoring it
+    with pytest.raises(ValueError, match="db_major"):
+        _bin_candidates(
+            jnp.zeros((4, 8), jnp.float32), jnp.zeros((256, 8), jnp.float32),
+            block_q=8, tile_n=2 * BIN_W, bin_w=BIN_W, survivors=2,
+            precision="bf16x3", interpret=True, grid_order="db_major",
+            kernel="streaming")
+
+
+def test_kernel_launch_accounting():
+    # the bench's launch-count contract: tiled = one pipelined body
+    # launch per train tile, streaming = ONE per (batch, shard)
+    assert kernel_launches_per_batch("tiled", 1_000_000, 16384) == 62
+    assert kernel_launches_per_batch("streaming", 1_000_000, 16384) == 1
+    assert kernel_launches_per_batch("tiled", 16384, 16384) == 1
+    with pytest.raises(ValueError, match="kernel"):
+        kernel_launches_per_batch("warp", 1000, 128)

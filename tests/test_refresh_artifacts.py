@@ -50,7 +50,10 @@ def test_cpu_line_never_supersedes_tpu(tmp_path):
         _line(100.0, backend="tpu", gate=True),
         _line(9999.0, backend="cpu", gate=True),  # faster but CPU
     ])
-    assert rows == [_line(100.0, backend="tpu", gate=True)]
+    want = _line(100.0, backend="tpu", gate=True)
+    assert len(rows) == 1
+    # curated content preserved; provenance fields ride alongside
+    assert {k: rows[0][k] for k in want} == want
 
 
 def test_green_gate_supersedes_red_and_drops_note(tmp_path):
@@ -101,6 +104,94 @@ def test_seeds_from_previous_round(tmp_path):
     # configs not re-measured this round survive with provenance intact
     assert by_cfg["knn_qps_b"]["value"] == 50.0
     assert by_cfg["knn_qps_a"]["value"] == 100.0
+
+
+def test_every_curated_line_carries_provenance(tmp_path):
+    # the provenance contract (round-5 verdict: GloVe/GIST republished
+    # round-3 numbers verbatim, unmarked): every written line must carry
+    # measured_round + measured_at_commit + stale — no exceptions
+    rows = _run(
+        tmp_path, 9,
+        [_line(100.0, gate=True, cfg="knn_qps_fresh")],
+        seed_lines=[_line(50.0, gate=True, cfg="knn_qps_carried")],
+    )
+    for r in rows:
+        assert "measured_round" in r, r
+        assert "measured_at_commit" in r, r
+        assert "stale" in r, r
+
+
+def test_fresh_line_stamped_current_round_not_stale(tmp_path):
+    rows = _run(tmp_path, 9, [_line(100.0, gate=True)])
+    (r,) = rows
+    assert r["measured_round"] == 9
+    assert r["stale"] is False
+    # a fresh session line gets the measuring checkout's commit (the
+    # isolated tmp dir is not a git repo -> the honest fallback)
+    assert r["measured_at_commit"]
+
+
+def test_carried_over_line_marked_stale(tmp_path):
+    # a config NOT re-measured this round survives from the seed file —
+    # but republication must say so on its face now
+    rows = _run(
+        tmp_path, 9,
+        [_line(100.0, gate=True, cfg="knn_qps_fresh")],
+        seed_lines=[_line(50.0, gate=True, cfg="knn_qps_old")],
+    )
+    by_cfg = {r["metric"]: r for r in rows}
+    old = by_cfg["knn_qps_old"]
+    assert old["measured_round"] == 8  # backfilled from the seed round
+    assert old["stale"] is True
+    assert old["measured_at_commit"] == "unknown(pre-provenance)"
+    fresh = by_cfg["knn_qps_fresh"]
+    assert fresh["measured_round"] == 9 and fresh["stale"] is False
+
+
+def test_existing_provenance_survives_reround(tmp_path):
+    # a line that already carries provenance (stamped by an earlier
+    # refresh or by bench.py itself) keeps it verbatim; only the stale
+    # judgment is recomputed relative to the new round
+    seed = _line(70.0, gate=True)
+    seed["measured_round"] = 7
+    seed["measured_at_commit"] = "abc1234"
+    rows = _run(tmp_path, 9, [], seed_lines=[seed])
+    (r,) = rows
+    assert r["measured_round"] == 7
+    assert r["measured_at_commit"] == "abc1234"
+    assert r["stale"] is True
+
+
+def test_unstamped_prev_curation_never_claims_current_round(tmp_path):
+    # a PRE-provenance line already sitting in this round's curated file
+    # is of unknowable measurement round (the flagged GloVe/GIST case):
+    # it must come out stale, never relabeled as freshly measured.  A
+    # genuinely fresh line recovers its stamp by re-feeding from the
+    # session file.
+    rows = _run(
+        tmp_path, 9,
+        [_line(100.0, gate=True, cfg="knn_qps_fresh")],
+        prev_curated=[_line(80.0, gate=True, cfg="knn_qps_legacy"),
+                      _line(100.0, gate=True, cfg="knn_qps_fresh")],
+    )
+    by_cfg = {r["metric"]: r for r in rows}
+    legacy = by_cfg["knn_qps_legacy"]
+    assert legacy["measured_round"] == 8 and legacy["stale"] is True
+    fresh = by_cfg["knn_qps_fresh"]
+    assert fresh["measured_round"] == 9 and fresh["stale"] is False
+
+
+def test_stale_recomputed_when_line_remeasured(tmp_path):
+    # the same config re-measured this round at a greener-or-equal rank
+    # supersedes the stale carry-over and drops the stale marker
+    seed = _line(70.0, gate=True)
+    seed["measured_round"] = 7
+    seed["measured_at_commit"] = "abc1234"
+    rows = _run(tmp_path, 9, [_line(90.0, gate=True)], seed_lines=[seed])
+    (r,) = rows
+    assert r["value"] == 90.0
+    assert r["measured_round"] == 9
+    assert r["stale"] is False
 
 
 def test_requires_explicit_round_argument(tmp_path):

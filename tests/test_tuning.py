@@ -1,0 +1,201 @@
+"""The persistent autotuner (knn_tpu.tuning): winner persistence and
+reload round-trips, cache-key mismatches fall back to defaults, the
+bitwise gate keeps broken candidates from ever winning, explicit
+pallas_knobs beat the cache, and a warm cache resolves with ZERO
+re-timing (pinned via the module counters — the same evidence
+`python -m knn_tpu.cli tune` prints)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import importlib
+
+from knn_tpu import tuning
+
+# the module object (the package re-exports the autotune FUNCTION under
+# the same name, so attribute access would shadow it)
+autotune_mod = importlib.import_module("knn_tpu.tuning.autotune")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def data(rng):
+    db = rng.normal(size=(700, 16)).astype(np.float32) * 10
+    q = rng.normal(size=(9, 16)).astype(np.float32) * 10
+    return db, q
+
+
+@pytest.fixture
+def cache_path(tmp_path):
+    return str(tmp_path / "autotune.json")
+
+
+def test_winner_persistence_and_reload_roundtrip(data, cache_path):
+    db, q = data
+    tuning.reset_counters()
+    entry = tuning.autotune(db, q, 5, margin=8, grid_level="quick", runs=1,
+                            cache_path=cache_path)
+    assert entry["cached"] is False
+    assert tuning.counters()["candidates_timed"] >= 3
+    assert os.path.exists(cache_path)
+    # the file is the documented format and reloads to the same winner
+    raw = json.load(open(cache_path))
+    assert raw["version"] == 1
+    (key,) = raw["entries"]
+    assert key == tuning.cache_key("cpu", 700, 16, 5, "l2", None)
+    reloaded = tuning.TuneCache(cache_path).get(key)
+    assert reloaded["knobs"] == entry["knobs"]
+    assert reloaded["winner_ms"] == entry["winner_ms"]
+    # resolve() for the same shape returns the persisted winner
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "cache"
+    assert knobs == {**tuning.DEFAULT_KNOBS, **entry["knobs"]}
+
+
+def test_warm_cache_zero_retiming(data, cache_path):
+    db, q = data
+    tuning.autotune(db, q, 5, margin=8, grid_level="quick", runs=1,
+                    cache_path=cache_path)
+    tuning.reset_counters()
+    entry = tuning.autotune(db, q, 5, margin=8, grid_level="quick", runs=1,
+                            cache_path=cache_path)
+    assert entry["cached"] is True
+    c = tuning.counters()
+    assert c["candidates_timed"] == 0  # ZERO re-timing on a warm cache
+    assert c["tune_searches"] == 0
+    assert c["cache_hits"] == 1
+
+
+def test_cache_key_mismatch_falls_back_to_defaults(data, cache_path):
+    db, q = data
+    tuning.autotune(db, q, 5, margin=8, grid_level="quick", runs=1,
+                    cache_path=cache_path)
+    # ANY key field mismatch must miss: different k, n, d, metric, dtype,
+    # device kind — a winner tuned for one shape says nothing elsewhere
+    for kwargs in (
+        dict(n=700, d=16, k=7),                       # k differs
+        dict(n=701, d=16, k=5),                       # n differs
+        dict(n=700, d=32, k=5),                       # d differs
+        dict(n=700, d=16, k=5, metric="cosine"),      # metric differs
+        dict(n=700, d=16, k=5, dtype="bfloat16"),     # dtype differs
+        dict(n=700, d=16, k=5, device_kind="TPU v5e"),  # device differs
+    ):
+        n = kwargs.pop("n")
+        d = kwargs.pop("d")
+        k = kwargs.pop("k")
+        knobs, info = tuning.resolve_full(n, d, k, cache_path=cache_path,
+                                          **kwargs)
+        assert info["source"] == "default", kwargs
+        assert knobs == tuning.DEFAULT_KNOBS
+
+
+def test_gate_failed_candidate_can_never_win(data, cache_path, monkeypatch):
+    db, q = data
+    real_search = autotune_mod._search_once
+
+    def corrupt_streaming(queries, dbx, k, margin, knobs):
+        d, i = real_search(queries, dbx, k, margin, knobs)
+        if knobs["kernel"] == "streaming":
+            i = np.array(i)
+            i[0, 0] = (i[0, 0] + 1) % dbx.shape[0]  # one wrong neighbor
+        return d, i
+
+    monkeypatch.setattr(autotune_mod, "_search_once", corrupt_streaming)
+    tuning.reset_counters()
+    entry = tuning.autotune(db, q, 5, margin=8, grid_level="quick", runs=1,
+                            cache_path=cache_path)
+    # the corrupted candidate is recorded ineligible (never timed) and
+    # cannot be selected no matter how fast it would have been
+    assert entry["timings_ms"]["kernel=streaming"] is None
+    assert "bitwise gate" in entry["errors"]["kernel=streaming"]
+    assert entry["knobs"]["kernel"] != "streaming"
+    assert tuning.counters()["candidates_gated_out"] >= 1
+    # and the persisted winner keeps the poison out of later resolves
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "cache"
+    assert knobs["kernel"] != "streaming"
+
+
+def test_explicit_knobs_beat_cache(data, cache_path, rng):
+    db, q = data
+    # seed the cache with a NON-default winner so the override direction
+    # is unambiguous
+    key = tuning.cache_key("cpu", 700, 16, 5, "l2", None)
+    tuning.TuneCache(cache_path).put(key, {
+        "knobs": {**tuning.DEFAULT_KNOBS, "kernel": "streaming",
+                  "tile_n": 256},
+        "winner_ms": 1.0,
+    })
+    knobs, info = tuning.resolve_full(
+        700, 16, 5, cache_path=cache_path,
+        overrides={"kernel": "tiled", "block_q": 16})
+    assert info["source"] == "cache"
+    assert knobs["kernel"] == "tiled"      # override beat the cache
+    assert knobs["tile_n"] == 256          # un-overridden cache knob kept
+    assert knobs["block_q"] == 16
+    assert info["overridden"] == ["block_q", "kernel"]
+
+    # end to end through ShardedKNN.search_certified: explicit args win,
+    # un-overridden knobs come from the cache, and the stats record both
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+
+    prog = ShardedKNN(db, mesh=make_mesh(1, 1), k=5)
+    _, i_cache, st = prog.search_certified(
+        q, selector="pallas", margin=8, tune_cache=cache_path)
+    assert st["tuning"]["source"] == "cache"
+    assert st["pallas_knobs"]["kernel"] == "streaming"  # cache winner ran
+    assert st["pallas_knobs"]["tile_n"] == 256
+    _, i_over, st2 = prog.search_certified(
+        q, selector="pallas", margin=8, tune_cache=cache_path,
+        kernel="tiled", tile_n=384)
+    assert st2["pallas_knobs"]["kernel"] == "tiled"
+    assert st2["pallas_knobs"]["tile_n"] == 384
+    assert set(st2["tuning"]["overridden"]) == {"kernel", "tile_n"}
+    # exactness is knob-independent (the certified contract)
+    np.testing.assert_array_equal(i_cache, i_over)
+
+
+def test_resolve_rejects_unknown_knob():
+    with pytest.raises(ValueError, match="unknown pallas knob"):
+        tuning.resolve(100, 8, 3, overrides={"warp_speed": 9})
+
+
+def test_corrupt_cache_degrades_to_defaults(cache_path):
+    with open(cache_path, "w") as f:
+        f.write("{not json")
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "default"
+    assert knobs == tuning.DEFAULT_KNOBS
+
+
+def test_cli_tune_roundtrip_zero_retiming(tmp_path):
+    """The acceptance path verbatim: `python -m knn_tpu.cli tune` on CPU
+    persists a cache file; a second run resolves from it with zero
+    re-timing, asserted via the counters in the CLI's JSON output."""
+    cache = str(tmp_path / "cli_tune.json")
+    args = [sys.executable, "-m", "knn_tpu.cli", "tune", "--n", "600",
+            "--dim", "8", "--k", "3", "--queries", "8", "--margin", "4",
+            "--grid", "quick", "--runs", "1", "--cache", cache]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+    def run():
+        r = subprocess.run(args, capture_output=True, text=True, env=env,
+                           timeout=420)
+        assert r.returncode == 0, r.stderr[-2000:]
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    first = run()
+    assert first["cached"] is False
+    assert first["counters"]["candidates_timed"] >= 3
+    assert os.path.exists(cache)
+    second = run()
+    assert second["cached"] is True
+    assert second["counters"]["candidates_timed"] == 0
+    assert second["counters"]["tune_searches"] == 0
+    assert second["knobs"] == first["knobs"]
