@@ -799,11 +799,15 @@ def main() -> None:
         qp, _ = prog._place_queries(pb_queries)
         _jax.block_until_ready(qp)
         h2d = time.perf_counter() - t0
-        norm_op = np.float32(prog._db_norm_max())
-        out = pp(qp, prog._tp, norm_op)
+        # the operand tail is precision-shaped (int8: the quantized
+        # placement; f32: the scalar norm bound) — ONE home,
+        # ShardedKNN._pallas_operands, so this probe can never call the
+        # program with the wrong arity
+        ops_tail = prog._pallas_operands(KNOBS["precision"])
+        out = pp(qp, prog._tp, *ops_tail)
         _jax.block_until_ready(out)  # warm/compiled
         t0 = time.perf_counter()
-        out = pp(qp, prog._tp, norm_op)
+        out = pp(qp, prog._tp, *ops_tail)
         _jax.block_until_ready(out)  # device-only time, no transfer
         dev = time.perf_counter() - t0
         t0 = time.perf_counter()
@@ -1112,6 +1116,28 @@ def main() -> None:
     fell_back = (backend == "cpu"
                  and os.environ.get("KNN_BENCH_PLATFORM") != "cpu")
     curated_ref = curated_tpu_reference() if fell_back else None
+    # quantization provenance: precision rides top-level on EVERY line so
+    # int8 A/B lines are self-describing and the artifact refresher can
+    # curate them separately from the f32-family line of the same config;
+    # int8 lines add the certified bound's worst case over this query set
+    # and the scales dtype (the reproducibility trio the ISSUE names)
+    quant_prov = {"precision": KNOBS["precision"]}
+    if KNOBS["precision"] == "int8":
+        try:
+            from knn_tpu.ops import quantize as _qz
+
+            pl8 = prog._int8_placement()
+            qb_prov = queries
+            if METRIC == "cosine":
+                from knn_tpu.parallel.sharded import _row_normalize_f64
+
+                qb_prov = _row_normalize_f64(queries)
+            eps = _qz.score_error_bound(
+                qb_prov, pl8["stats"], offset=pl8["offset"])
+            quant_prov["quant_bound_max"] = float(np.max(eps))
+            quant_prov["quant_scales_dtype"] = "float32"
+        except Exception as e:  # noqa: BLE001 — provenance must not kill the line
+            quant_prov["quant_bound_error"] = f"{type(e).__name__}: {e}"
     _emit({
         "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
         "value": qps,
@@ -1160,6 +1186,7 @@ def main() -> None:
         # tuning block records where each run's knobs came from
         # (persisted autotuner winner vs defaults vs env overrides)
         "pallas_knobs": {**KNOBS, "batch": PALLAS_BATCH, "margin": MARGIN},
+        **quant_prov,
         "tuning": TUNE_INFO,
         "approx_knobs": {"recall_target": APPROX_RT,
                          "margin": APPROX_MARGIN},

@@ -30,7 +30,7 @@ import sys
 from typing import Optional, Sequence
 
 from knn_tpu.ops.metrics import METRICS  # dependency-free; does not pull JAX
-from knn_tpu.utils.config import BACKENDS, JobConfig
+from knn_tpu.utils.config import BACKENDS, CERTIFIED_PRECISIONS, JobConfig
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -95,6 +95,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--selector pallas (default: $KNN_TPU_TUNE_CACHE or "
         "~/.cache/knn_tpu/autotune.json; populate it with the `tune` "
         "subcommand)",
+    )
+    p.add_argument(
+        "--pallas-precision", default=None,
+        choices=CERTIFIED_PRECISIONS,
+        help="kernel matmul precision for --mode certified --selector "
+        "pallas; 'int8' runs the quantized MXU coarse pass (db quantized "
+        "once at placement, certify threshold widened by the provable "
+        "per-query bound — results stay exact by construction).  Unset = "
+        "the persisted autotuner winner / library default",
     )
     return p
 
@@ -203,6 +212,7 @@ def args_to_config(args: argparse.Namespace) -> JobConfig:
         max_wait_ms=args.max_wait_ms,
         num_threads=args.num_threads,
         tune_cache=args.tune_cache,
+        pallas_precision=args.pallas_precision,
     )
 
 

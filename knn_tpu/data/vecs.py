@@ -44,6 +44,20 @@ def read_bvecs(path: str) -> np.ndarray:
     return _read_vecs(path, np.uint8, 1)
 
 
+def read_bvecs_quantized(path: str):
+    """bvecs payload fed to the int8 coarse pass DIRECTLY
+    (ops.quantize.QuantizedRows): the uint8 bytes re-centered by the
+    L2-invariant -128 shift land exactly in int8 at UNIT scale — no f32
+    quantization round trip, residuals identically zero, so the
+    certificate's quantization bound collapses to pure f32 slack.
+    ``ShardedKNN`` built from the raw ``read_bvecs`` uint8 array applies
+    the same shortcut at placement time; this loader is for callers
+    driving ``ops.pallas_knn`` / ``ops.quantize`` themselves."""
+    from knn_tpu.ops.quantize import from_uint8
+
+    return from_uint8(read_bvecs(path))
+
+
 def _write_vecs(path: str, x: np.ndarray, dtype) -> None:
     x = np.ascontiguousarray(x, dtype=dtype)
     n, dim = x.shape

@@ -244,6 +244,27 @@ def repair_uncertified(
     return out
 
 
+def pallas_candidate_fn(**knobs):
+    """A ``candidate_fn`` for :func:`knn_search_certified` that runs the
+    fused Pallas kernel's coarse pass (ops.pallas_knn) at any supported
+    precision — including the int8 MXU arm (``precision="int8"``, which
+    quantizes both sides per call via ops.quantize).
+
+    The count-below certificate is COARSE-PRECISION-INDEPENDENT: step 3
+    counts EVERY database row against the float64-refined threshold, so
+    a quantized (or outright wrong) coarse pass can raise the fallback
+    rate but can never cost exactness — no threshold widening by the
+    quantization bound ε is needed on this path, unlike the one-pass
+    exclusion-bound certificate (parallel.sharded), whose lb lives in
+    kernel-score space and therefore widens by ε there."""
+    from knn_tpu.ops.pallas_knn import pallas_knn_candidates
+
+    def fn(q, db, m):
+        return pallas_knn_candidates(q, db, m, **knobs)
+
+    return fn
+
+
 def knn_search_certified(
     queries,
     db,
@@ -259,8 +280,9 @@ def knn_search_certified(
     approximate pipeline.  Returns (dists_f64 [Q, k], idx [Q, k], stats).
 
     ``candidate_fn(queries, db, m) -> [Q, m] indices`` overrides the coarse
-    pass (e.g. with the Pallas bin-min kernel, ops.pallas_knn); default is
-    the ApproxTopK selector.
+    pass (e.g. with the Pallas bin-min kernel — see
+    :func:`pallas_candidate_fn`, incl. the int8 arm); default is the
+    ApproxTopK selector.
 
     ``stats`` reports ``fallback_queries`` — how many queries failed
     certification and reran exactly (0 in the common case; correctness

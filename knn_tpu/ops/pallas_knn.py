@@ -149,10 +149,23 @@ _I32MAX = jnp.iinfo(jnp.int32).max
 #: "bf16x3f" computes the SAME three-term sum as one dot over a 3x-wide
 #: contraction ([qh|qh|ql] @ [th|tl|th]^T) — one MXU op and one f32
 #: accumulator instead of three partials round-tripping VMEM; identical
-#: error model, 1.5x the db streaming bytes.  "highest" is the native
-#: f32 path; "default" is for experiments only — its error is
-#: certificate-hostile (~2^-10 relative, measured).
-PRECISIONS = ("bf16x3", "bf16x3f", "highest", "default")
+#: error model, 1.5x the db streaming bytes.  "int8" is the hardware's
+#: fastest scoring mode: per-row symmetrically quantized q and t
+#: (ops.quantize), ONE int8 MXU dot per chunk (int32-exact, ~2x bf16
+#: throughput, 1/4 the db streaming bytes) rescaled to f32 by the
+#: per-query x per-row scale product — its certified tolerance is the
+#: PROVABLE per-query quantization bound ε (quantize.score_error_bound),
+#: so misses fall back, never leak.  "highest" is the native f32 path;
+#: "default" is for experiments only — its error is certificate-hostile
+#: (~2^-10 relative, measured).
+PRECISIONS = ("bf16x3", "bf16x3f", "int8", "highest", "default")
+
+#: kernel/emitter code version: BUMP whenever the kernel arithmetic, the
+#: emitters, or the knob semantics change — the autotuner's persisted
+#: winner cache keys on it (tuning.cache.cache_key), so winners measured
+#: against older kernel code self-invalidate instead of silently steering
+#: a changed kernel.  3 = int8 emitter path added (PR 3).
+KERNEL_VERSION = 3
 
 #: relative slack of the device rank stage's direct-difference f32
 #: distances: per-term (q-t)^2 rounding plus the depth-7 tree reduce give
@@ -321,6 +334,23 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
         q3 = jnp.concatenate([qh, qh, ql], axis=1)  # [BQ, 3*DIM_CHUNK]
         qt = lax.dot_general(q3, t3_ref[:], dn,
                              preferred_element_type=jnp.float32)
+    elif precision == "int8":
+        # q arrives PRE-QUANTIZED int8 (the XLA prologue in
+        # _bin_candidates quantized it once per call, like the bf16
+        # split); the db tile streams as int8 and the dot accumulates in
+        # int32 — EXACTLY, across every dim chunk (|qi.ti| <= 2^14 * d
+        # can't overflow below d ~ 2^17), so the chunk loop is pure
+        # integer arithmetic and the ONE f32 rescale (per-query x
+        # per-row scale product, applied at select time) is the only
+        # rounding site — which is also what makes the tiled and
+        # streaming kernels bitwise-identical here: integer adds admit
+        # no fusion/reassociation rounding differences.  The aux block
+        # stacks row norms (sublanes 0-7) over row scales (8-15) so the
+        # db side streams ONE extra lane-major array, not two.
+        ti_ref, qsc_ref, aux_ref, d_ref, i_ref, b_ref, *scratch = refs
+        tn_ref = aux_ref
+        qt = lax.dot_general(q, ti_ref[:], dn,
+                             preferred_element_type=jnp.int32)
     else:
         t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch = refs
         prec = (lax.Precision.HIGHEST if precision == "highest"
@@ -334,6 +364,12 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
     emit = _emit_select_grouped if binning == "grouped" else _emit_select
 
     def write(qt_acc):
+        if precision == "int8":
+            # the one rescale: full int32 dot -> f32 (rounded for
+            # d > 1040, covered by the bound's f32 slack), times the
+            # per-query [BQ, 1] and per-row [1, T] scales
+            qt_acc = ((qt_acc.astype(jnp.float32) * qsc_ref[:, 0:1])
+                      * aux_ref[8:9, :])
         cd, ci, bound = emit(
             ti, qt_acc, tn_ref[:], tile_n=tile_n, bin_w=bin_w,
             n_bins=n_bins, survivors=survivors, out_w=out_w,
@@ -458,7 +494,7 @@ def _emit_select_grouped(ti, qt, tn, *,
 def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
                    survivors: int, out_w: int, bound_w: int, n_tiles: int,
                    nd: int, precision: str, binning: str, n_parts: int,
-                   chunk_w: int):
+                   chunk_w: int, aux_rows: int = 8):
     """One launch per (batch, shard): the db-side arrays stay in HBM and
     stream tile-by-tile through TWO VMEM scratch slots via explicit
     async copies — tile i+1's HBM->VMEM copy overlaps tile i's MXU
@@ -470,13 +506,19 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
     the selection) is bitwise-identical to the tiled kernel's.
 
     Ref layout (inputs, then outputs, then scratch):
-      [db part HBM refs x n_parts]  bf16x3: th, tl | bf16x3f: t3 | else: db
-      tn HBM ref                    [8, n_tiles * tile_n] row norms
+      [qsc VMEM ref]                int8 only: [BQ, 128] query scales
+      [db part HBM refs x n_parts]  bf16x3: th, tl | bf16x3f: t3 |
+                                    int8: quantized db | else: db
+      tn HBM ref                    [aux_rows, n_tiles * tile_n] row
+                                    norms (int8: norms over scales)
       d_ref, i_ref, b_ref           full-width VMEM output blocks
       [part VMEM buffers x n_parts] (2, tile_n, chunk_w) double buffers
-      tn VMEM buffer                (2, 8, tile_n)
+      tn VMEM buffer                (2, aux_rows, tile_n)
       sem                           DMA semaphores (2, n_parts + 1)
     """
+    qsc_ref = None
+    if precision == "int8":
+        qsc_ref, refs = refs[0], refs[1:]
     parts_hbm = refs[:n_parts]
     tn_hbm = refs[n_parts]
     d_ref, i_ref, b_ref = refs[n_parts + 1 : n_parts + 4]
@@ -507,12 +549,18 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
             part_dma(j, ti, c, slot).start()
 
     def chunk_qt(c, bufs):
-        """[BQ, tile_n] f32 score contribution of dim chunk ``c`` —
-        the same per-chunk arithmetic as the tiled kernel body (the
-        query chunk is a static slice of the full-dim block here where
-        the tiled kernel's BlockSpec sliced it; the cast/dot sequence
-        is identical, which the bitwise contract rests on)."""
+        """[BQ, tile_n] score contribution of dim chunk ``c`` — the
+        same per-chunk arithmetic as the tiled kernel body (the query
+        chunk is a static slice of the full-dim block here where the
+        tiled kernel's BlockSpec sliced it; the cast/dot sequence is
+        identical, which the bitwise contract rests on).  int8 returns
+        the raw int32 partial dot (exact integer accumulation; the one
+        f32 rescale happens at emit time, like the tiled kernel)."""
         qc = q[:, c * DIM_CHUNK : (c + 1) * DIM_CHUNK]
+        if precision == "int8":
+            t, = bufs
+            return lax.dot_general(qc, t, dn,
+                                   preferred_element_type=jnp.int32)
         if precision == "bf16x3":
             th, tl = bufs
             qh = qc.astype(jnp.bfloat16)
@@ -559,8 +607,13 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
                     tn_dma(ti + 1, (ti + 1) % 2).start()
             qt_c = chunk_qt(c, [part_bufs[j][slot] for j in range(n_parts)])
             # same accumulation order as the tiled kernel's qt scratch
+            # (int8: exact int32 adds — order-independent by construction)
             qt = qt_c if qt is None else qt + qt_c
         tn_dma(ti, ti % 2).wait()
+        if precision == "int8":
+            # the one f32 rescale, same op sequence as the tiled write()
+            qt = ((qt.astype(jnp.float32) * qsc_ref[:, 0:1])
+                  * tn_buf[ti % 2][8:9, :])
         cd, ci, bound = emit(
             ti, qt, tn_buf[ti % 2], tile_n=tile_n, bin_w=bin_w,
             n_bins=n_bins, survivors=survivors, out_w=out_w,
@@ -598,7 +651,7 @@ def _on_tpu() -> bool:
 @functools.partial(
     jax.jit, static_argnames=("block_q", "tile_n", "bin_w", "survivors",
                               "precision", "interpret", "binning",
-                              "grid_order", "kernel")
+                              "grid_order", "kernel", "offset")
 )
 def _bin_candidates(
     queries: jax.Array,
@@ -613,6 +666,8 @@ def _bin_candidates(
     binning: str = "grouped",
     grid_order: str = "query_major",
     kernel: str = "tiled",
+    db_int8: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    offset: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Kernel launch on padded shapes.  Returns
 
@@ -626,7 +681,16 @@ def _bin_candidates(
     dim-padding preserves scores exactly; PAD_VAL row-padding scores
     ~1e36 so pads never surface (module docstring).  ``kernel`` picks
     the db-streaming strategy (KERNELS); outputs are bitwise-identical
-    across strategies."""
+    across strategies.
+
+    ``precision="int8"`` adds a quantized coarse arm (ops.quantize):
+    queries quantize per call in an XLA prologue (like the bf16 split);
+    the db either quantizes the same way (``db_int8=None`` — the
+    convenience/test/autotune path) or arrives PRE-QUANTIZED as
+    ``db_int8=(values int8 [N,D], scales f32 [N], row_norms f32 [N])``
+    — the ShardedKNN placement path, where the f32 db never re-streams
+    for the coarse pass.  ``offset`` is the translation-invariance shift
+    both sides subtract before quantizing (128.0 for bvecs payloads)."""
     queries = _pad_axis(queries.astype(jnp.float32), block_q, 0)
     queries = _pad_axis(queries, DIM_CHUNK, 1)
     db = _pad_axis(db.astype(jnp.float32), tile_n, 0, fill=PAD_VAL)
@@ -636,11 +700,6 @@ def _bin_candidates(
     nd = dim // DIM_CHUNK
     n_bins, survivors, out_w, bound_w = _geometry(
         tile_n, bin_w, survivors, binning)
-    # full-dim db row norms, f32, broadcast to 8 sublanes so the kernel
-    # reads them as a lane-major [8, tile_n] block
-    tnorm = jnp.broadcast_to(
-        jnp.sum(db * db, axis=-1)[None, :], (8, db.shape[0])
-    )
 
     if precision not in PRECISIONS:
         raise ValueError(f"precision {precision!r} not in {PRECISIONS}")
@@ -655,6 +714,9 @@ def _bin_candidates(
         raise ValueError(
             "kernel='streaming' streams the db inside one launch; "
             "grid_order='db_major' does not apply")
+    queries_in = queries
+    q_extra = []  # int8: the per-query-row scale block rides as an input
+    aux_rows = 8
     if precision in ("bf16x3", "bf16x3f"):
         # the high/low split of the db happens ONCE in XLA; the kernel
         # streams bf16 tiles and never re-derives them per query block
@@ -671,9 +733,51 @@ def _bin_candidates(
                 db.shape[0], nd * 3 * DIM_CHUNK)
             db_inputs = [t3]
             chunk_w = 3 * DIM_CHUNK
+    elif precision == "int8":
+        from knn_tpu.ops.quantize import quantize_rows
+
+        # queries quantize per call (one XLA prologue pass, like the
+        # bf16 split); the db either quantizes here too (convenience /
+        # autotune path) or arrives pre-quantized from the placement
+        qi, qsc = quantize_rows(queries - offset)
+        queries_in = qi
+        q_extra = [jnp.broadcast_to(qsc[:, None], (qp, BIN_W))]
+        if db_int8 is None:
+            db_sh = db - offset
+            ti, ts = quantize_rows(db_sh)
+            tn_rows = jnp.sum(db_sh * db_sh, axis=-1)
+        else:
+            ti, ts, tn_rows = db_int8
+            # tile-padding of the pre-quantized arrays: zero int8 rows at
+            # zero scale dequantize to the origin, and a huge norm fill
+            # makes their kernel score ~PAD_VAL — never a candidate,
+            # never deflating a bin bound (same contract as PAD_VAL rows)
+            ti = _pad_axis(ti, tile_n, 0)
+            ti = _pad_axis(ti, DIM_CHUNK, 1)
+            ts = _pad_axis(ts[:, None], tile_n, 0)[:, 0]
+            tn_rows = _pad_axis(tn_rows[:, None], tile_n, 0,
+                                fill=PAD_VAL)[:, 0]
+        db_inputs = [ti]
+        chunk_w = DIM_CHUNK
+        # the db-side aux block stacks norms over scales ([16, N]: rows
+        # 0-7 tn broadcast, 8-15 scales broadcast) so BOTH stream through
+        # the one lane-major aux slot the f32 path already has
+        aux_rows = 16
     else:
         db_inputs = [db]
         chunk_w = DIM_CHUNK
+    if precision == "int8":
+        tnorm = jnp.concatenate([
+            jnp.broadcast_to(tn_rows[None, :], (8, db.shape[0])),
+            jnp.broadcast_to(ts[None, :].astype(jnp.float32),
+                             (8, db.shape[0])),
+        ], axis=0)
+    else:
+        # full-dim db row norms, f32, broadcast to 8 sublanes so the
+        # kernel reads them as a lane-major [8, tile_n] block
+        tnorm = jnp.broadcast_to(
+            jnp.sum(db * db, axis=-1)[None, :], (8, db.shape[0])
+        )
     out_shape = [
         jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.float32),
         jax.ShapeDtypeStruct((qp, n_tiles * out_w), jnp.int32),
@@ -682,11 +786,12 @@ def _bin_candidates(
 
     if kernel == "streaming":
         return _stream_call(
-            queries, db_inputs, tnorm, out_shape, qp=qp, dim=dim,
+            queries_in, db_inputs, tnorm, out_shape, qp=qp, dim=dim,
             block_q=block_q, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
             survivors=survivors, out_w=out_w, bound_w=bound_w,
             n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
             chunk_w=chunk_w, interpret=interpret,
+            q_extra=q_extra, aux_rows=aux_rows,
         )
 
     db_major = grid_order == "db_major"
@@ -727,13 +832,19 @@ def _bin_candidates(
             vmem_limit_bytes=max(64, 3 * score_mb + 24) * 1024 * 1024,
         )
     db_specs = [pl.BlockSpec((tile_n, chunk_w), t_idx) for _ in db_inputs]
+    if db_major:
+        s_idx = lambda t, q, d: (q, 0)      # noqa: E731
+    else:
+        s_idx = lambda q, t, d: (q, 0)      # noqa: E731
+    extra_specs = [pl.BlockSpec((block_q, BIN_W), s_idx) for _ in q_extra]
     return pl.pallas_call(
         body,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_q, DIM_CHUNK), q_idx),
             *db_specs,
-            pl.BlockSpec((8, tile_n), n_idx),
+            *extra_specs,
+            pl.BlockSpec((aux_rows, tile_n), n_idx),
         ],
         out_specs=[
             pl.BlockSpec((block_q, out_w), o_idx),
@@ -744,26 +855,33 @@ def _bin_candidates(
         # the qt accumulation scratch is only touched when dim spans
         # multiple chunks; at dim <= 128 (the headline shape) skipping it
         # returns VMEM to the pipeline
+        # int8 accumulates the raw int32 dot across chunks (exact);
+        # the f32 paths accumulate the scaled f32 score
         scratch_shapes=[] if nd == 1 else [
-            pltpu.VMEM((block_q, tile_n), jnp.float32),
+            pltpu.VMEM((block_q, tile_n),
+                       jnp.int32 if precision == "int8" else jnp.float32),
         ],
         interpret=interpret,
         **kwargs,
-    )(queries, *db_inputs, tnorm)
+    )(queries_in, *db_inputs, *q_extra, tnorm)
 
 
 def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
                  tile_n, bin_w, n_bins, survivors, out_w, bound_w, n_tiles,
-                 nd, precision, binning, chunk_w, interpret):
+                 nd, precision, binning, chunk_w, interpret,
+                 q_extra=(), aux_rows=8):
     """The streaming ``pallas_call``: grid over query blocks only, db
     parts + row norms left in compiler-chosen (HBM) memory and streamed
-    by the kernel's own double-buffered DMA loop (``_stream_kernel``)."""
+    by the kernel's own double-buffered DMA loop (``_stream_kernel``).
+    ``q_extra`` carries the int8 query-scale block (a small VMEM input
+    alongside the query block); ``aux_rows`` is 16 when the aux array
+    stacks scales under norms (int8), else 8."""
     n_parts = len(db_inputs)
     body = functools.partial(
         _stream_kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
         survivors=survivors, out_w=out_w, bound_w=bound_w,
         n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
-        n_parts=n_parts, chunk_w=chunk_w,
+        n_parts=n_parts, chunk_w=chunk_w, aux_rows=aux_rows,
     )
     any_space = getattr(pltpu, "ANY", None) or pltpu.TPUMemorySpace.ANY
     part_dtype = db_inputs[0].dtype
@@ -775,7 +893,7 @@ def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
         # overflows the chip still fails at compile time, never silently.
         out_b = block_q * (2 * n_tiles * out_w + n_tiles * bound_w) * 4
         buf_b = 2 * (n_parts * tile_n * chunk_w * part_dtype.itemsize
-                     + 8 * tile_n * 4)
+                     + aux_rows * tile_n * 4)
         score_b = block_q * tile_n * 4
         budget = min(120, (out_b + buf_b + 2 * score_b) // 2 ** 20 + 32)
         kwargs["compiler_params"] = _compiler_params(
@@ -787,6 +905,8 @@ def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
         grid=(qp // block_q,),
         in_specs=[
             pl.BlockSpec((block_q, dim), lambda q: (q, 0)),
+            *[pl.BlockSpec((block_q, BIN_W), lambda q: (q, 0))
+              for _ in q_extra],
             *[pl.BlockSpec(memory_space=any_space) for _ in db_inputs],
             pl.BlockSpec(memory_space=any_space),
         ],
@@ -799,19 +919,20 @@ def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
         scratch_shapes=[
             *[pltpu.VMEM((2, tile_n, chunk_w), part_dtype)
               for _ in db_inputs],
-            pltpu.VMEM((2, 8, tile_n), jnp.float32),
+            pltpu.VMEM((2, aux_rows, tile_n), jnp.float32),
             pltpu.SemaphoreType.DMA((2, n_parts + 1)),
         ],
         interpret=interpret,
         **kwargs,
-    )(queries, *db_inputs, tnorm)
+    )(queries, *q_extra, *db_inputs, tnorm)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("m", "tile_n", "block_q", "bin_w", "survivors",
                      "precision", "final_select", "interpret", "binning",
-                     "final_recall_target", "grid_order", "kernel"),
+                     "final_recall_target", "grid_order", "kernel",
+                     "offset"),
 )
 def local_certified_candidates(
     q: jax.Array,
@@ -829,6 +950,8 @@ def local_certified_candidates(
     final_recall_target: Optional[float] = None,
     grid_order: str = "query_major",
     kernel: str = "tiled",
+    db_int8: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    offset: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole device-side certified coarse pass against one db (shard):
 
@@ -853,7 +976,18 @@ def local_certified_candidates(
        then ordered lexicographically by (distance, index).
 
     Callable inside shard_map; parallel.sharded merges (d32, idx) across
-    db shards and pmin's lb."""
+    db shards and pmin's lb.
+
+    ``precision="int8"`` runs the quantized coarse arm: the kernel score
+    lives in SHIFTED space (``offset`` subtracted from both sides before
+    quantization — squared L2 is translation invariant), ``lb`` with it,
+    and the certificate widens its threshold by the provable per-query
+    quantization bound ε (ops.quantize).  ``db_int8`` plugs the
+    placement-time quantized db in (values, scales, row norms — see
+    ``_bin_candidates``); the stage-3 rescore ALWAYS gathers the f32
+    ``t`` rows, so the returned d32 values and the near-tie analysis are
+    precision-independent — the quantization only steers which
+    candidates surface, never what their distances read."""
     if interpret is None:
         interpret = not _on_tpu()
     eff_tile = effective_tile(t.shape[0], tile_n, bin_w, survivors,
@@ -862,7 +996,7 @@ def local_certified_candidates(
         q, t, block_q=min(block_q, max(8, q.shape[0])), tile_n=eff_tile,
         bin_w=bin_w, survivors=survivors, precision=precision,
         interpret=interpret, binning=binning, grid_order=grid_order,
-        kernel=kernel,
+        kernel=kernel, db_int8=db_int8, offset=offset,
     )
     n_q = q.shape[0]
     cd, ci, bounds = cd[:n_q], ci[:n_q], bounds[:n_q]
@@ -953,6 +1087,7 @@ def kernel_tolerance(
     queries_np: np.ndarray, db_np: np.ndarray,
     *, db_norm_max: Optional[float] = None, precision: str = "bf16x3",
     q_norm: Optional[np.ndarray] = None,
+    quant=None,
 ) -> np.ndarray:
     """Per-query bound on |kernel score - exact score| — the certificate
     comparison's slack, by kernel matmul mode.  Mirrors the on-device
@@ -966,6 +1101,12 @@ def kernel_tolerance(
     - "bf16x3": the dropped ql.tl term and the low-part rounding are each
       <= 2^-17 (||q||^2 + max||t||^2)/2; 2^-14 gives ~8x headroom (and
       subsumes every f32 accumulation term).
+    - "int8": the PROVABLE per-query quantization bound ε derived from
+      the actual residual norms (ops.quantize.score_error_bound; the
+      property test in tests/test_quantize.py pins its soundness).
+      ``quant`` supplies the placement's QuantizedRows; None quantizes
+      ``db_np`` here (host pass — fine for the gate scripts this
+      function serves).
     """
     from knn_tpu.ops.certified import certification_tolerance
 
@@ -976,13 +1117,23 @@ def kernel_tolerance(
     base = 4.0 * certification_tolerance(
         queries_np, db_np, db_norm_max=db_norm_max, q_norm=q_norm
     )
+    if precision == "int8":
+        from knn_tpu.ops import quantize as qz
+
+        if quant is None:
+            quant = qz.quantize_rows_np(db_np)
+        stats = qz.db_bound_stats(quant, db_np)
+        return np.maximum(
+            base,
+            qz.score_error_bound(queries_np, stats, offset=quant.offset),
+        )
     if precision in ("bf16x3", "bf16x3f"):
         return np.maximum(base, 2.0 ** -14 * (q_norm + db_norm_max))
     if precision == "highest":
         return base
     raise ValueError(
         f"precision {precision!r} has no certified tolerance model; "
-        f"use 'bf16x3', 'bf16x3f', or 'highest'"
+        f"use 'bf16x3', 'bf16x3f', 'int8', or 'highest'"
     )
 
 

@@ -354,6 +354,20 @@ class ShardedKNN:
             raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
         metric = metric.lower()  # dispatch below compares lowercase names
         self._cosine_unit = False  # db rows normalized at placement?
+        #: uint8 source rows (SIFT-style bvecs payloads): kept so an int8
+        #: coarse pass reuses the bytes EXACTLY (unit scale, -128 shift —
+        #: ops.quantize.from_uint8) instead of round-tripping through f32
+        #: quantization.  Cosine normalizes rows at placement, so the
+        #: byte-exact shortcut doesn't apply there.
+        self._uint8_train = None
+        if (isinstance(train, np.ndarray) and train.dtype == np.uint8
+                and metric != "cosine"):
+            self._uint8_train = train
+            train = train.astype(np.float32)
+        #: lazily built int8 db placement (quantized values + scales +
+        #: row norms + bound consts), cached per instance — "quantize
+        #: once at placement time", the int8 arm's whole HBM story
+        self._int8_cache = None
         db_shards = mesh.shape[DB_AXIS]
         pre_placed = (
             isinstance(train, jax.Array)
@@ -698,6 +712,70 @@ class ShardedKNN:
             )
         return self._db_norm_max_cache
 
+    def _int8_placement(self) -> dict:
+        """The quantized db placement for the int8 coarse pass, built
+        LAZILY on first use and cached: per-row symmetric int8 values +
+        f32 scales + f32 shifted-space row norms live on device sharded
+        along the db axis (1/4 the coarse-pass HBM traffic of the f32
+        db), plus the replicated bound-consts vector the certificate
+        widens its threshold with (ops.quantize.bound_consts).  uint8
+        sources (bvecs payloads) ride byte-exact at unit scale; anything
+        else quantizes the host f32 rows once.  The f32 placement
+        (``self._tp``) stays — the rescore gather, the fallback
+        programs, and every non-int8 selector still read it."""
+        if self._int8_cache is None:
+            from knn_tpu.ops import quantize as qz
+
+            with self._engines_lock:
+                if self._int8_cache is not None:
+                    return self._int8_cache
+                host = self._host_train()
+                if self._uint8_train is not None:
+                    qr = qz.from_uint8(self._uint8_train)
+                    original = self._uint8_train
+                else:
+                    qr = qz.quantize_rows_np(host)
+                    original = host
+                stats = qz.db_bound_stats(qr, original)
+                # pad to the f32 placement's row count: zero rows at zero
+                # scale with a huge norm score ~PAD_VAL — never candidates
+                # (the kernel masks them by index anyway), never deflating
+                # an exclusion bound
+                rows = self._tp.shape[0]
+                pad = rows - qr.values.shape[0]
+                vals = np.pad(qr.values, ((0, pad), (0, 0)))
+                scl = np.pad(qr.scales, (0, pad)).astype(np.float32)
+                # shifted-space f32 row norms, computed in f64 then cast
+                # (error < 1 ulp — tighter than an f32 reduction tree)
+                tn = np.empty(rows, dtype=np.float32)
+                for lo in range(0, host.shape[0], 65536):
+                    hs = host[lo : lo + 65536].astype(np.float64) - qr.offset
+                    tn[lo : lo + hs.shape[0]] = (hs ** 2).sum(-1)
+                from knn_tpu.ops.pallas_knn import PAD_VAL
+
+                tn[host.shape[0]:] = PAD_VAL
+                self._int8_cache = {
+                    "values": shard(vals, self.mesh, DB_AXIS),
+                    "scales": shard(scl, self.mesh, DB_AXIS),
+                    "norms": shard(tn, self.mesh, DB_AXIS),
+                    "consts": replicate(qz.bound_consts(stats), self.mesh),
+                    "offset": float(qr.offset),
+                    "stats": stats,
+                }
+        return self._int8_cache
+
+    def _pallas_operands(self, precision: str) -> tuple:
+        """The operand tail of the pallas certified program after
+        ``(queries, db)`` — ONE home shared by :meth:`_certify_pallas`
+        and bench.py's phase breakdown so neither can call the program
+        with the wrong arity: int8 passes the quantized placement; the
+        f32 precisions pass the scalar db-norm bound."""
+        if precision == "int8":
+            pl8 = self._int8_placement()
+            return (pl8["values"], pl8["scales"], pl8["norms"],
+                    pl8["consts"])
+        return (np.float32(self._db_norm_max()),)
+
     def search_certified(
         self, queries, *, margin: int = 28, selector: str = "approx",
         batch_size: Optional[int] = None, tile_n: Optional[int] = None,
@@ -725,7 +803,14 @@ class ShardedKNN:
           axis) proves no neighbor was missed — two database passes.
         - ``"pallas"``: the fused kernel's exclusion bound IS the
           certificate (ops.pallas_knn) — ONE database pass; ``tile_n`` and
-          ``precision`` tune the kernel.
+          ``precision`` tune the kernel.  ``precision="int8"`` streams a
+          per-row-quantized int8 db (placed lazily, once — ops.quantize;
+          ~2x bf16 MXU throughput, 1/4 the coarse HBM traffic) and widens
+          the certify threshold by the PROVABLE per-query quantization
+          bound ε, so quantization misses land in the fallback, never in
+          the answer; uint8 (bvecs) databases ride byte-exact at unit
+          scale.  The f32 placement stays resident for the rescore
+          gather and the fallback/count programs.
 
         Queries failing certification rerun exactly either way; the
         returned INDICES are the exact lexicographic top-k regardless of
@@ -1012,14 +1097,21 @@ class ShardedKNN:
             effective_tile,
         )
 
-        if precision not in ("bf16x3", "bf16x3f", "highest"):
+        from knn_tpu.utils.config import CERTIFIED_PRECISIONS
+
+        if precision not in CERTIFIED_PRECISIONS:
             # "default" has no certified tolerance model (its matmul error
             # is ~2^-10 relative — certificate-hostile); refuse rather
             # than silently certify garbage
             raise ValueError(
                 f"precision {precision!r} has no certified tolerance "
-                f"model; use 'bf16x3', 'bf16x3f', or 'highest'"
+                f"model; use one of {CERTIFIED_PRECISIONS}"
             )
+        quant_offset = 0.0
+        if precision == "int8":
+            # builds (and caches) the quantized placement: the program
+            # needs the translation-invariance shift as a static constant
+            quant_offset = self._int8_placement()["offset"]
 
         eff_bin = bin_w or BIN_W
         shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
@@ -1054,6 +1146,7 @@ class ShardedKNN:
             include_distances=include_distances, binning=binning,
             final_recall_target=final_recall_target,
             grid_order=grid_order, kernel=kernel,
+            quant_offset=quant_offset,
         )
         return prog, m, _analysis_window(self.k, m)
 
@@ -1085,13 +1178,16 @@ class ShardedKNN:
                                         grid_order=grid_order,
                                         kernel=kernel)
 
-        # stage 1: dispatch every batch (async on device)
-        norm_op = np.float32(db_norm_max)
+        # stage 1: dispatch every batch (async on device).  The operand
+        # tail is precision-shaped (int8: the quantized placement; f32:
+        # the scalar norm bound) — ONE home, _pallas_operands
+        ops_tail = self._pallas_operands(precision)
         outs = []
         for lo, chunk, pad in batches:
             qp, _ = self._place_queries(chunk)
             outs.append((qp, _retry_transient(
-                lambda q=qp: prog(q, self._tp, norm_op), "pallas dispatch")))
+                lambda q=qp: prog(q, self._tp, *ops_tail),
+                "pallas dispatch")))
 
         # stage 2: per batch — ONE fetch of the packed output (the relay
         # charges a fixed latency per transfer), then repair tie runs
@@ -1100,7 +1196,7 @@ class ShardedKNN:
         for (lo, chunk, pad), (qp, packed) in zip(batches, outs):
             take = bs - pad
             packed_np = _fetch_or_redispatch(
-                packed, lambda q=qp: prog(q, self._tp, norm_op),
+                packed, lambda q=qp: prog(q, self._tp, *ops_tail),
                 "pallas fetch")
             gi_np, tight_np, bad_np, dk_np = unpack_certified(
                 packed_np[:take], k, w, want_distances
@@ -1257,6 +1353,7 @@ def _pallas_certified_program(
     final_recall_target: Optional[float] = None,
     grid_order: str = "query_major",
     kernel: str = "tiled",
+    quant_offset: float = 0.0,
 ):
     """ONE-pass sharded self-certifying coarse select + device rank +
     device certificate (ops.pallas_knn.local_certified_candidates per
@@ -1286,7 +1383,18 @@ def _pallas_certified_program(
     Soundness: a db row outside the candidates has kernel score >= lb,
     or was merge-dropped with direct distance >= d32[:, m]; ``bad`` is
     the union of both checks plus rows whose tie run crosses the
-    analysis window (no provable top-k boundary)."""
+    analysis window (no provable top-k boundary).
+
+    ``precision="int8"`` swaps the operand tail: instead of the scalar
+    ``db_norm_max`` the program takes the quantized placement
+    ``(values, scales, norms)`` (each db-sharded) plus the replicated
+    bound-consts vector, and the certificate's tolerance becomes the
+    per-query PROVABLE quantization bound ε (ops.quantize.
+    score_error_bound_device) — the kernel scores and lb live in the
+    ``quant_offset``-shifted space, so the comparison uses the shifted
+    query norm (squared L2 is translation invariant; the f32 rescore
+    distances d32 are space-independent up to RANK_SLACK, which the
+    derivation already budgets)."""
     from knn_tpu.ops.pallas_knn import (
         BIN_W,
         BLOCK_Q,
@@ -1300,13 +1408,21 @@ def _pallas_certified_program(
     eff_bin = bin_w or BIN_W
     eff_bq = block_q or BLOCK_Q
     w = _analysis_window(k, m)
+    int8 = precision == "int8"
 
-    def spmd(q, t, db_norm_max):
+    def spmd(q, t, *tail):
+        if int8:
+            tq, ts, tnr, consts = tail
+            db_int8 = (tq, ts, tnr)
+        else:
+            (db_norm_max,) = tail
+            db_int8 = None
         d32, li, lb = local_certified_candidates(
             q, t, m, tile_n=eff_tile, bin_w=eff_bin, survivors=survivors,
             block_q=eff_bq, final_select=final_select, precision=precision,
             binning=binning, final_recall_target=final_recall_target,
             grid_order=grid_order, kernel=kernel,
+            db_int8=db_int8, offset=quant_offset,
         )
         db_idx = lax.axis_index(DB_AXIS)
         gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,
@@ -1344,12 +1460,21 @@ def _pallas_certified_program(
         # tolerances mirror ops.pallas_knn.kernel_tolerance and include
         # the extra f32 reduction this on-device path adds (q_norm +
         # s_k arithmetic, <= ~12 eps of the norm scale): "highest" budgets
-        # 32 eps total; bf16x3's 2^-14 dwarfs the f32 terms either way
+        # 32 eps total; bf16x3's 2^-14 dwarfs the f32 terms either way.
+        # int8's tolerance is the per-query PROVABLE quantization bound ε
+        # from the ACTUAL residual norms — byte-exact data (bvecs) gets
+        # an ε of pure f32 slack, tighter than bf16x3's.
         q32 = q.astype(jnp.float32)
-        q_norm = jnp.sum(q32 * q32, axis=-1)
-        if precision in ("bf16x3", "bf16x3f"):
+        if int8:
+            from knn_tpu.ops.quantize import score_error_bound_device
+
+            q_norm, tol = score_error_bound_device(
+                q32 - quant_offset, consts)
+        elif precision in ("bf16x3", "bf16x3f"):
+            q_norm = jnp.sum(q32 * q32, axis=-1)
             tol = 2.0 ** -14 * (q_norm + db_norm_max)
         else:
+            q_norm = jnp.sum(q32 * q32, axis=-1)
             tol = 32.0 * float(np.finfo(np.float32).eps) * (
                 q_norm + db_norm_max)
         d_k = dw[:, k - 1]
@@ -1370,11 +1495,14 @@ def _pallas_certified_program(
             cols.append(lax.bitcast_convert_type(d32[:, :k], jnp.int32))
         return jnp.concatenate(cols, axis=1)
 
+    tail_specs = (
+        (P(DB_AXIS), P(DB_AXIS), P(DB_AXIS), P()) if int8 else (P(),)
+    )
     return jax.jit(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P()),
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS), *tail_specs),
             out_specs=P(QUERY_AXIS),
             check_vma=False,
         )

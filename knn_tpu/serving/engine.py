@@ -247,7 +247,13 @@ class ServingEngine:
     def warmup(self, ops: Sequence[str] = ("search",)) -> Dict[str, int]:
         """AOT-compile every bucket for each requested op so no live
         request ever pays an inline compile.  Returns per-op executable
-        counts (ladder rungs sharing a placed shape share an executable)."""
+        counts (ladder rungs sharing a placed shape share an executable).
+
+        When the autotuner's persisted winner for this placement's shape
+        resolves ``precision="int8"``, warmup also builds the quantized
+        db placement (ShardedKNN._int8_placement) — a one-time full-db
+        quantize + transfer that would otherwise land on the first live
+        certified query."""
         counts = {}
         for op in ops:
             if op not in OPS:
@@ -257,6 +263,14 @@ class ServingEngine:
             with self._lock:  # concurrent cold compiles mutate _execs
                 keys = list(self._execs)
             counts[op] = len({k for k in keys if k[0] == op})
+        info = self._tuning_info()
+        if (info and info.get("resolved_knobs", {}).get("precision")
+                == "int8"):
+            try:
+                self.program._int8_placement()
+                counts["int8_placement"] = 1
+            except Exception:  # pragma: no cover - placement best-effort
+                pass  # a live int8 call will rebuild (and surface) it
         return counts
 
     # -- dispatch ----------------------------------------------------------

@@ -60,14 +60,33 @@ def default_cache_path() -> str:
         os.path.expanduser("~"), ".cache", "knn_tpu", "autotune.json")
 
 
+def kernel_version_token() -> str:
+    """The kernel/emitter code version baked into every cache key
+    (ops.pallas_knn.KERNEL_VERSION): a winner is a MEASUREMENT of one
+    kernel build, so when the kernel code changes the persisted entry's
+    key no longer matches and resolve falls back to defaults — stale
+    winners self-invalidate instead of silently steering a kernel they
+    never timed.  Lazy import: the cache module itself stays jax-free
+    until a key is actually built."""
+    try:
+        from knn_tpu.ops.pallas_knn import KERNEL_VERSION
+
+        return str(KERNEL_VERSION)
+    except Exception:  # pragma: no cover - import failure -> never match
+        return "unknown"
+
+
 def cache_key(device_kind: str, n: int, d: int, k: int, metric: str,
               dtype: Optional[str]) -> str:
     """The shape key a winner is valid for.  ``dtype`` is the placement
     compute dtype (None = float32, the library default); any field
     mismatch MUST miss — a winner tuned for one shape says nothing
-    about another."""
+    about another.  The trailing ``kv<version>`` token ties the entry to
+    the kernel code that was measured (:func:`kernel_version_token`);
+    pre-token entries (no ``|kv`` suffix) miss the same way."""
     return (f"{device_kind}|n{int(n)}|d{int(d)}|k{int(k)}|"
-            f"{metric.lower()}|{dtype or 'float32'}")
+            f"{metric.lower()}|{dtype or 'float32'}"
+            f"|kv{kernel_version_token()}")
 
 
 class TuneCache:

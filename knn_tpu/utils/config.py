@@ -20,6 +20,13 @@ from knn_tpu.ops.metrics import METRICS
 #: oracle (knn_tpu.native, SURVEY.md §7 step 3).
 BACKENDS = ("jax", "native")
 
+#: kernel matmul precisions with a certified tolerance model —
+#: ops.pallas_knn.PRECISIONS minus the uncertifiable "default".  ONE
+#: home (jax-free, so the CLI can build its --help without importing
+#: JAX); cli.py's choices, this module's validation, and
+#: parallel.sharded's _pallas_setup check all consume it.
+CERTIFIED_PRECISIONS = ("bf16x3", "bf16x3f", "highest", "int8")
+
 
 @dataclass
 class JobConfig:
@@ -82,6 +89,12 @@ class JobConfig:
     #: kernel knobs resolve from it through tuning.resolve, and the
     #: resolved set lands in metrics()["certified_stats"]["pallas_knobs"].
     tune_cache: Optional[str] = None
+    #: explicit kernel matmul precision for the certified pallas
+    #: selector (ops.pallas_knn.PRECISIONS minus the uncertifiable
+    #: "default"): "bf16x3" | "bf16x3f" | "highest" | "int8" (the
+    #: quantized MXU arm — ops.quantize).  None = resolve through the
+    #: autotuner cache / library default; an explicit value beats both.
+    pallas_precision: Optional[str] = None
     # --- native backend knobs ---
     num_threads: int = 0  # 0 = hardware concurrency
 
@@ -102,6 +115,11 @@ class JobConfig:
             raise ValueError(f"mode {self.mode!r} not in ('exact', 'certified')")
         if self.selector not in ("exact", "approx", "pallas"):
             raise ValueError(f"selector {self.selector!r} unknown")
+        if self.pallas_precision is not None and \
+                self.pallas_precision not in CERTIFIED_PRECISIONS:
+            raise ValueError(
+                f"pallas_precision {self.pallas_precision!r} not in "
+                f"{CERTIFIED_PRECISIONS}")
         if self.mode == "certified" and self.metric not in (
             "l2", "sql2", "euclidean", "cosine"
         ):

@@ -108,6 +108,21 @@ def main() -> int:
          (qs, db), dict(m=128, block_q=256, tile_n=32768,
                         final_select="exact", interpret=False,
                         binning="grouped")),
+        # the int8 MXU arm (PR 3): both db-streaming strategies must
+        # lower with the quantized inputs (int8 q/db blocks, the [16, N]
+        # norms-over-scales aux, the int32 dot + one f32 rescale) before
+        # a TPU session spends minutes timing them
+        ("kernel grouped t16384 int8", _bin_candidates, (qs, db),
+         dict(block_q=128, tile_n=16384, bin_w=128, survivors=2,
+              precision="int8", interpret=False, binning="grouped")),
+        ("kernel grouped t16384 int8 streaming", _bin_candidates, (qs, db),
+         dict(block_q=128, tile_n=16384, bin_w=128, survivors=2,
+              precision="int8", interpret=False, binning="grouped",
+              kernel="streaming")),
+        ("certified grouped t16384 int8 exact", local_certified_candidates,
+         (qs, db), dict(m=128, block_q=128, tile_n=16384,
+                        final_select="exact", interpret=False,
+                        binning="grouped", precision="int8")),
         # db-major grid order: each db tile streams ONCE per sweep
         # (docs/PERF.md cost model says query-major's db re-streaming is
         # the largest kernel term); interpret-mode bitwise-equal to

@@ -95,6 +95,13 @@ def feed(path, source_round, fresh=False):
         cfg = rec.get("metric")
         if not cfg or rec.get("value") is None:
             continue
+        # int8 lines curate under their own key: an int8 A/B measurement
+        # of a config must never supersede (or be superseded by) the
+        # f32-family line of the same config — they are different
+        # arithmetic, published side by side.  Lines without the
+        # precision field (pre-int8 history) keep their bare metric key.
+        if rec.get("precision") == "int8":
+            cfg = f"{cfg}+int8"
         rec.setdefault("measured_round", source_round)
         if "measured_at_commit" not in rec:
             rec["measured_at_commit"] = (

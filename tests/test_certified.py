@@ -129,3 +129,21 @@ def test_persistent_certificate_failure_goes_host_exact(rng):
     np.testing.assert_allclose(d, ref_d, rtol=1e-12)
     assert stats["fallback_queries"] >= 1
     assert stats.get("host_exact_queries", 0) >= 1
+
+
+def test_certified_int8_pallas_candidates_stay_exact(data):
+    # the int8 Pallas coarse pass plugged into the COUNTED certificate:
+    # the count-below pass is coarse-precision-independent (it counts
+    # every db row against the f64-refined threshold), so quantization
+    # error can only raise the fallback rate — results equal the oracle
+    from knn_tpu.ops.certified import pallas_candidate_fn
+
+    db, queries = data
+    ref_d, ref_i = _oracle(db, queries, 8)
+    d, i, stats = knn_search_certified(
+        queries, db, 8, tile=128,
+        candidate_fn=pallas_candidate_fn(precision="int8", tile_n=256),
+    )
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_allclose(d, ref_d, rtol=1e-9)
+    assert stats["fallback_queries"] + stats["certified"] == queries.shape[0]

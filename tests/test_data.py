@@ -144,3 +144,25 @@ def test_make_mnist_like_shapes_and_accuracy_band():
     pred = np.array([np.bincount(trl[i], minlength=10).argmax() for i in idx])
     acc = (pred == tel).mean()
     assert 0.88 <= acc <= 0.995, acc
+
+
+def test_bvecs_quantized_loader_is_byte_exact(tmp_path, rng):
+    # bvecs payload -> int8 coarse-pass feed: unit scales, -128 shift,
+    # dequantization reproduces the bytes exactly (no f32 round trip)
+    from knn_tpu.data.vecs import read_bvecs_quantized
+    from knn_tpu.ops.quantize import dequantize
+
+    x = rng.integers(0, 256, size=(13, 9), dtype=np.uint8)
+    n, dim = x.shape
+    rows = np.concatenate(
+        [np.full((n, 1), dim, np.int32).view(np.uint8).reshape(n, 4), x],
+        axis=1)
+    p = str(tmp_path / "q.bvecs")
+    rows.tofile(p)
+    qr = read_bvecs_quantized(p)
+    assert qr.values.dtype == np.int8
+    assert qr.offset == 128.0
+    np.testing.assert_array_equal(qr.scales, np.ones(13, np.float32))
+    np.testing.assert_array_equal(
+        qr.values.astype(np.int16), x.astype(np.int16) - 128)
+    np.testing.assert_array_equal(dequantize(qr), x.astype(np.float32))

@@ -94,7 +94,8 @@ def test_db_major_grid_bitwise_equal_query_major(rng):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-@pytest.mark.parametrize("precision", ["highest", "bf16x3", "bf16x3f"])
+@pytest.mark.parametrize("precision", ["highest", "bf16x3", "bf16x3f",
+                                       "int8"])
 @pytest.mark.parametrize("binning,grid_order", [
     ("grouped", "query_major"), ("lane", "query_major"),
     ("grouped", "db_major"),

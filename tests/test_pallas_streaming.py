@@ -32,6 +32,7 @@ def _oracle(db, queries, k):
 @pytest.mark.parametrize("precision,binning", [
     ("bf16x3", "grouped"), ("bf16x3f", "grouped"), ("highest", "grouped"),
     ("bf16x3", "lane"), ("default", "grouped"),
+    ("int8", "grouped"), ("int8", "lane"),
 ])
 def test_streaming_bitwise_equals_tiled_bin_candidates(rng, dim, precision,
                                                        binning):
@@ -51,12 +52,14 @@ def test_streaming_bitwise_equals_tiled_bin_candidates(rng, dim, precision,
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.parametrize("precision", ["bf16x3", "int8"])
 @pytest.mark.parametrize("n_rows", [
     2 * BIN_W,          # exactly one tile
     2 * BIN_W + 1,      # one row past a tile edge
     5 * BIN_W + 60,     # several tiles, ragged tail
 ])
-def test_streaming_bitwise_equals_tiled_certified_stage(rng, n_rows):
+def test_streaming_bitwise_equals_tiled_certified_stage(rng, n_rows,
+                                                        precision):
     # the full certified candidate stage (kernel + final select + f32
     # rescore): d32, idx, AND the exclusion bound must agree bitwise
     db = rng.normal(size=(n_rows, 24)).astype(np.float32) * 10
@@ -65,7 +68,8 @@ def test_streaming_bitwise_equals_tiled_certified_stage(rng, n_rows):
     for kern in ("tiled", "streaming"):
         outs[kern] = local_certified_candidates(
             jnp.asarray(queries), jnp.asarray(db), m=13, block_q=8,
-            tile_n=2 * BIN_W, interpret=True, kernel=kern)
+            tile_n=2 * BIN_W, interpret=True, kernel=kern,
+            precision=precision)
     for a, b in zip(outs["tiled"], outs["streaming"]):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
@@ -159,3 +163,104 @@ def test_kernel_launch_accounting():
     assert kernel_launches_per_batch("tiled", 16384, 16384) == 1
     with pytest.raises(ValueError, match="kernel"):
         kernel_launches_per_batch("warp", 1000, 128)
+
+
+# --- int8 coarse arm (the quantized MXU path, ops.quantize) -------------
+
+def _int8_exact_data(rng, n_rows, dim):
+    """Integer-valued data whose per-row max is pinned at 127: the int8
+    quantization is then EXACT (unit scales, zero residuals) and every
+    kernel score is a small integer computed exactly by BOTH the bf16x3
+    reference and the int8 arm — which is what makes FINAL results
+    bitwise comparable across precisions (fallback-pattern divergence
+    cannot leak into the values: all distances are < 2^24 integers,
+    exact in f32 and f64 alike)."""
+    db = rng.integers(-100, 101, size=(n_rows, dim)).astype(np.float32)
+    db[:, 0] = 127.0  # pins max|row| -> scale exactly 1.0
+    return db
+
+
+@pytest.mark.parametrize("kern", ["tiled", "streaming"])
+@pytest.mark.parametrize("n_rows", [
+    2 * BIN_W,          # exactly one tile
+    2 * BIN_W + 1,      # ragged: one row past a tile edge
+    5 * BIN_W + 60,     # several tiles, ragged tail
+])
+def test_int8_final_results_bitwise_vs_reference(rng, n_rows, kern):
+    """THE acceptance gate: precision='int8' reproduces the reference
+    grouped config's FINAL certified (distances, indices) bitwise, across
+    both db-streaming kernels and ragged tile counts — including
+    cross-tile duplicate ties (exact distance ties resolved by the
+    lexicographic rule + f64 rank correction)."""
+    dim, k = 12, 7
+    db = _int8_exact_data(rng, n_rows, dim)
+    # cross-tile duplicates + a query ON a duplicated pair: exact ties
+    dup = min(40, n_rows - 2 * BIN_W) if n_rows > 2 * BIN_W else 20
+    db[n_rows - dup:] = db[:dup]
+    queries = _int8_exact_data(rng, 9, dim)
+    queries[0] = db[0]  # exact-tie pileup on a duplicated row
+    ref_d, ref_i, _ = knn_search_pallas(queries, db, k, tile_n=2 * BIN_W,
+                                        margin=8)
+    d, i, stats = knn_search_pallas(queries, db, k, tile_n=2 * BIN_W,
+                                    margin=8, precision="int8",
+                                    kernel=kern)
+    np.testing.assert_array_equal(i, ref_i)
+    np.testing.assert_array_equal(d, ref_d)
+    # and both equal the float64 oracle (exactness, not just agreement)
+    oracle_d, oracle_i = _oracle(db, queries, k)
+    np.testing.assert_array_equal(i, oracle_i)
+    np.testing.assert_allclose(d, oracle_d, rtol=0, atol=0)
+
+
+def test_int8_noisy_data_indices_exact_with_fallback(rng):
+    """Real (non-representable) f32 data: quantization error is genuine,
+    the certificate widens by the bound, and whatever falls back repairs
+    — final INDICES equal the oracle unconditionally."""
+    db = rng.normal(size=(5 * BIN_W + 31, 16)).astype(np.float32) * 10
+    # near-tie pileup: distances inside the quantization band, forcing
+    # the widened certificate to flag + repair rather than trust the rank
+    queries = rng.normal(size=(8, 16)).astype(np.float32) * 10
+    db[100:110] = queries[1][None, :] + rng.normal(
+        size=(10, 16)).astype(np.float32) * 1e-2
+    ref_d, ref_i = _oracle(db, queries, 6)
+    for kern in ("tiled", "streaming"):
+        d, i, stats = knn_search_pallas(queries, db, 6, tile_n=2 * BIN_W,
+                                        margin=8, precision="int8",
+                                        kernel=kern)
+        np.testing.assert_array_equal(i, ref_i)
+        np.testing.assert_allclose(d, ref_d, rtol=5e-5)
+        assert stats["fallback_queries"] + stats["certified"] == 8
+
+
+def test_int8_sharded_search_certified_bitwise(rng):
+    # sharded db: the quantized placement shards along the db axis, one
+    # launch per shard, lb pmin'd — tiled and streaming int8 agree
+    # bitwise end to end and match the oracle indices
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+
+    db = rng.normal(size=(1500, 12)).astype(np.float32) * 20
+    queries = rng.normal(size=(9, 12)).astype(np.float32) * 20
+    prog = ShardedKNN(db, mesh=make_mesh(2, 4), k=5)
+    out = {}
+    for kern in ("tiled", "streaming"):
+        d, i, stats = prog.search_certified(
+            queries, selector="pallas", margin=8, tile_n=2 * BIN_W,
+            precision="int8", kernel=kern)
+        out[kern] = (d, i)
+        assert stats["pallas_knobs"]["precision"] == "int8"
+    np.testing.assert_array_equal(out["tiled"][0], out["streaming"][0])
+    np.testing.assert_array_equal(out["tiled"][1], out["streaming"][1])
+    ref_d, ref_i = _oracle(db, queries, 5)
+    np.testing.assert_array_equal(out["streaming"][1], ref_i)
+    # the quantized placement was built once and cached
+    assert prog._int8_cache is not None
+
+
+def test_int8_uncertifiable_default_precision_still_refused(rng):
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+
+    db = rng.normal(size=(600, 8)).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(1, 1), k=3)
+    with pytest.raises(ValueError, match="tolerance model"):
+        prog.search_certified(db[:4], selector="pallas",
+                              precision="default")

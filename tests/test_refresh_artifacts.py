@@ -209,3 +209,30 @@ def test_requires_explicit_round_argument(tmp_path):
     assert r.returncode != 0
     assert "usage" in (r.stderr + r.stdout)
     assert not list(tmp_path.glob("TPU_BENCH_*.jsonl"))  # nothing written
+
+
+def test_int8_line_curated_separately_from_f32_family(tmp_path):
+    # an int8 A/B line of the SAME config must neither supersede nor be
+    # superseded by the f32-family line — they are different arithmetic,
+    # published side by side; both carry the full provenance/stale guard
+    f32_line = dict(_line(100.0, gate=True), precision="bf16x3")
+    int8_line = dict(_line(180.0, gate=True), precision="int8",
+                     quant_bound_max=12.5, quant_scales_dtype="float32")
+    out = _run(tmp_path, 9, [f32_line, int8_line])
+    assert len(out) == 2
+    by_prec = {r.get("precision"): r for r in out}
+    assert by_prec["bf16x3"]["value"] == 100.0
+    assert by_prec["int8"]["value"] == 180.0
+    assert by_prec["int8"]["quant_bound_max"] == 12.5
+    for r in out:  # the stale-line guard covers int8 lines unchanged
+        assert r["measured_round"] == 9 and r["stale"] is False
+        assert "measured_at_commit" in r
+
+
+def test_int8_carryover_marked_stale_like_any_line(tmp_path):
+    old8 = dict(_line(150.0, gate=True), precision="int8",
+                measured_round=8, measured_at_commit="abc")
+    out = _run(tmp_path, 9, [], prev_curated=[old8])
+    (r,) = out
+    assert r["precision"] == "int8"
+    assert r["measured_round"] == 8 and r["stale"] is True

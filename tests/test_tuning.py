@@ -199,3 +199,85 @@ def test_cli_tune_roundtrip_zero_retiming(tmp_path):
     assert second["counters"]["candidates_timed"] == 0
     assert second["counters"]["tune_searches"] == 0
     assert second["knobs"] == first["knobs"]
+
+
+def test_cache_key_carries_kernel_version_token():
+    from knn_tpu.ops.pallas_knn import KERNEL_VERSION
+
+    key = tuning.cache_key("cpu", 700, 16, 5, "l2", None)
+    assert key.endswith(f"|kv{KERNEL_VERSION}")
+
+
+def test_stale_kernel_version_entry_falls_back_to_defaults(cache_path):
+    """A persisted winner keyed for an OLDER kernel build (different —
+    or missing — kv token) must miss: winners are measurements of one
+    kernel's code, and a changed kernel invalidates them."""
+    key = tuning.cache_key("cpu", 700, 16, 5, "l2", None)
+    base = key.rsplit("|kv", 1)[0]
+    cache = tuning.TuneCache(cache_path)
+    # pre-token entry (the old key format) AND a wrong-version entry
+    cache.put(base, {"knobs": {**tuning.DEFAULT_KNOBS,
+                               "kernel": "streaming"}})
+    cache.put(base + "|kv-stale", {"knobs": {**tuning.DEFAULT_KNOBS,
+                                             "tile_n": 256}})
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "default"
+    assert knobs == tuning.DEFAULT_KNOBS
+    # a current-version entry under the same shape DOES hit
+    cache.put(key, {"knobs": {**tuning.DEFAULT_KNOBS, "block_q": 16}})
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "cache"
+    assert knobs["block_q"] == 16
+
+
+def test_standard_grid_includes_int8_candidate():
+    grid = tuning.knob_grid("standard")
+    assert any(c["precision"] == "int8" for c in grid)
+    # quick stays int8-free (CPU-interpret friendly minimal set)
+    assert all(c["precision"] != "int8" for c in tuning.knob_grid("quick"))
+    # full covers int8 x streaming (the HBM-bound cross)
+    assert any(c["precision"] == "int8" and c["kernel"] == "streaming"
+               for c in tuning.knob_grid("full"))
+
+
+def test_gated_out_int8_candidate_can_never_win(data, cache_path,
+                                                monkeypatch):
+    """The acceptance clause verbatim: the bitwise end-result gate
+    applies to the int8 candidate unchanged, and a gated-out int8
+    candidate can never win — however fast it would have timed."""
+    db, q = data
+    real_search = autotune_mod._search_once
+
+    def corrupt_int8(queries, dbx, k, margin, knobs):
+        d, i = real_search(queries, dbx, k, margin, knobs)
+        if knobs["precision"] == "int8":
+            i = np.array(i)
+            i[0, 0] = (i[0, 0] + 1) % dbx.shape[0]  # one wrong neighbor
+        return d, i
+
+    monkeypatch.setattr(autotune_mod, "_search_once", corrupt_int8)
+    tuning.reset_counters()
+    grid = [dict(tuning.DEFAULT_KNOBS),
+            {**tuning.DEFAULT_KNOBS, "precision": "int8"}]
+    entry = tuning.autotune(db, q, 5, margin=8, grid=grid, runs=1,
+                            cache_path=cache_path)
+    assert entry["timings_ms"]["precision=int8"] is None  # never timed
+    assert "bitwise gate" in entry["errors"]["precision=int8"]
+    assert entry["knobs"]["precision"] != "int8"
+    assert tuning.counters()["candidates_gated_out"] >= 1
+
+
+def test_int8_candidate_eligible_when_results_match(rng, cache_path):
+    """On int8-exactly-representable data the int8 candidate passes the
+    bitwise gate (final results == reference) and is timed — eligibility
+    is decided by the gate, not by precision prejudice."""
+    db = rng.integers(-100, 101, size=(700, 16)).astype(np.float32)
+    db[:, 0] = 127.0  # pins every row scale at exactly 1.0
+    q = rng.integers(-100, 101, size=(9, 16)).astype(np.float32)
+    q[:, 0] = 127.0
+    grid = [dict(tuning.DEFAULT_KNOBS),
+            {**tuning.DEFAULT_KNOBS, "precision": "int8"}]
+    entry = tuning.autotune(db, q, 5, margin=8, grid=grid, runs=1,
+                            cache_path=cache_path)
+    assert entry["timings_ms"]["precision=int8"] is not None
+    assert "precision=int8" not in entry["errors"]
