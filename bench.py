@@ -166,6 +166,11 @@ try:
     SERVING_REQUESTS = _env_int("KNN_BENCH_SERVING_REQUESTS", 48)
     SERVING_DEPTH = _env_int("KNN_BENCH_SERVING_DEPTH", 2)
     SERVING_MIN_BUCKET = _env_opt_int("KNN_BENCH_SERVING_MIN_BUCKET")
+    #: measure telemetry overhead (knn_tpu.obs): replay the serving
+    #: trace twice — registry disabled, then enabled — and report
+    #: obs_overhead_pct = (qps_off - qps_on) / qps_off * 100.  Opt-in:
+    #: the double replay costs a second trace of chip time.
+    OBS_OVERHEAD = os.environ.get("KNN_BENCH_OBS_OVERHEAD", "0") == "1"
 except Exception as _e:  # bad env: the one-JSON-line contract still holds
     print(json.dumps({
         "metric": "knn_qps_config", "value": None, "unit": "queries/s",
@@ -735,9 +740,45 @@ def main() -> None:
             lo = int(t_rng.integers(0, max(1, NQ - int(s))))
             reqs.append(queries[lo : lo + int(s)])
         _, report = eng.replay(reqs, depth=SERVING_DEPTH)
+        obs_overhead = None
+        if OBS_OVERHEAD:
+            # A/B the SAME trace with telemetry off, then on — fresh
+            # engines so neither run inherits the other's counters;
+            # warmup() keeps compiles out of both replay windows.  The
+            # ambient registry state is restored afterwards (env-driven).
+            from knn_tpu import obs as _obs
+
+            qps = {}
+            for on in (False, True):
+                _obs.reset(enabled=on)
+                e2 = ServingEngine(
+                    prog, min_bucket=min_bucket, max_bucket=BATCH)
+                e2.warmup()
+                # one untimed replay first: each arm's executables pay
+                # their first-execution costs OUTSIDE the timed window,
+                # or the off-first ordering reads as phantom overhead;
+                # then best-of-3 per arm — replay jitter dwarfs the
+                # per-event cost, so the comparison needs the noise
+                # floor pushed down, not one sample
+                e2.replay(reqs, depth=SERVING_DEPTH)
+                best = None
+                for _ in range(3):
+                    _, rep2 = e2.replay(reqs, depth=SERVING_DEPTH)
+                    if rep2["sustained_qps"] is not None:
+                        best = max(best or 0.0, rep2["sustained_qps"])
+                qps[on] = best
+            _obs.reset()
+            if qps[False] and qps[True]:
+                obs_overhead = round(
+                    (qps[False] - qps[True]) / qps[False] * 100.0, 3)
         return {
             "sustained_qps": report["sustained_qps"],
             "latency_ms": report["latency_ms"],
+            # telemetry overhead on this trace (None = not measured; set
+            # KNN_BENCH_OBS_OVERHEAD=1): negative values are replay
+            # noise — the honest reading is "below noise floor"
+            **({"obs_overhead_pct": obs_overhead}
+               if obs_overhead is not None else {}),
             "trace_requests": report["requests"],
             "trace_queries": report["total_queries"],
             "trace_wall_s": report["wall_s"],
@@ -1151,6 +1192,11 @@ def main() -> None:
         **({
             "serving_sustained_qps": results["serving"].get("sustained_qps"),
             "serving_latency_ms": results["serving"].get("latency_ms"),
+            # rides top-level only when measured (KNN_BENCH_OBS_OVERHEAD):
+            # the refresher curates it with the line, stale-guard and all
+            **({"obs_overhead_pct":
+                results["serving"]["obs_overhead_pct"]}
+               if "obs_overhead_pct" in results["serving"] else {}),
         } if results.get("serving", {}).get("sustained_qps") else {}),
         **(gate or {}),
         "recall_at_k": results[best].get("recall_at_k"),

@@ -11,7 +11,7 @@ Usage mirrors the reference job:
 Prints the reference's two lines (``accuracy = ...`` knn_mpi.cpp:348 and
 ``Running time is ... second`` :398) plus optional structured JSON metrics.
 
-One subcommand rides alongside the job interface:
+Two subcommands ride alongside the job interface:
 
     python -m knn_tpu.cli tune --n 1000000 --dim 128 --k 100
 
@@ -21,6 +21,15 @@ knob set to the on-disk cache, where every subsequent
 ``search_certified``/bench run on the same device kind resolves it with
 zero re-timing — the reproducible replacement for the per-session hand
 search of scripts/tpu_session_r5b.py.
+
+    python -m knn_tpu.cli metrics --port 9100
+    python -m knn_tpu.cli metrics --snapshot /path/run_metrics.json --format prom
+
+reads the telemetry of a RUNNING process (its ``--metrics-port``
+endpoint) or an atomic JSON snapshot file (knn_tpu.obs exporters) and
+prints it as Prometheus text or JSON — the scrape/debug companion of
+the job flags ``--metrics-port`` / ``--obs-log``
+(docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -81,6 +90,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--num-threads", type=int, default=0, help="native backend threads (0 = all cores)")
     p.add_argument("--metrics-json", default=None, help="write structured run metrics to this path")
+    p.add_argument(
+        "--metrics-port", type=int, default=None, metavar="PORT",
+        help="serve live telemetry over HTTP while the job runs: "
+        "/metrics (Prometheus text) + /metrics.json (knn_tpu.obs; "
+        "scrape with `python -m knn_tpu.cli metrics --port PORT`)",
+    )
+    p.add_argument(
+        "--metrics-snapshot", default=None, metavar="PATH",
+        help="write an atomic JSON telemetry snapshot (tmp+rename) at "
+        "job end — the file-based exporter for runs nothing scrapes "
+        "live",
+    )
+    p.add_argument(
+        "--obs-log", default=None, metavar="PATH",
+        help="append structured telemetry events (trace spans, compile "
+        "events) to this JSONL file ($KNN_TPU_OBS_LOG equivalent)",
+    )
     p.add_argument(
         "--cpu-devices",
         type=int,
@@ -187,6 +213,59 @@ def run_tune(args: argparse.Namespace) -> int:
     return 0
 
 
+def build_metrics_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu metrics",
+        description="Read telemetry from a running process's "
+        "--metrics-port endpoint or from an atomic JSON snapshot file "
+        "(knn_tpu.obs) and print it as Prometheus text or JSON.",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--port", type=int, default=None,
+                     help="fetch from http://HOST:PORT (a process "
+                     "started with --metrics-port)")
+    src.add_argument("--snapshot", default=None, metavar="PATH",
+                     help="read an atomic JSON snapshot file "
+                     "(--metrics-snapshot / obs.write_json_snapshot)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="endpoint host for --port (default localhost)")
+    p.add_argument("--format", default="prom", choices=("prom", "json"),
+                   help="output format (Prometheus text | snapshot JSON)")
+    return p
+
+
+def run_metrics(args: argparse.Namespace) -> int:
+    """The `metrics` subcommand — jax-free by construction (knn_tpu.obs
+    imports no JAX): scraping a box must not pay a backend init."""
+    import json
+    import urllib.request
+
+    if args.port is not None:
+        path = "/metrics" if args.format == "prom" else "/metrics.json"
+        url = f"http://{args.host}:{args.port}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                sys.stdout.write(r.read().decode())
+        except OSError as e:
+            print(f"metrics endpoint {url} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        return 0
+    try:
+        with open(args.snapshot) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read snapshot {args.snapshot}: {e}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        print(json.dumps(payload, indent=1, sort_keys=True))
+    else:
+        from knn_tpu.obs import prometheus_text
+
+        sys.stdout.write(prometheus_text(payload.get("metrics", {})))
+    return 0
+
+
 def args_to_config(args: argparse.Namespace) -> JobConfig:
     return JobConfig(
         train_file=args.train,
@@ -228,6 +307,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
             request_cpu_devices(targs.cpu_devices)
         return run_tune(targs)
+    if argv[:1] == ["metrics"]:
+        return run_metrics(build_metrics_parser().parse_args(argv[1:]))
     args = build_parser().parse_args(argv)
     if args.cpu_devices:
         # Must precede backend initialization; env vars are too late when a
@@ -235,15 +316,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from knn_tpu.utils.compat import request_cpu_devices
 
         request_cpu_devices(args.cpu_devices)
+    server = None
+    if args.obs_log or args.metrics_port is not None \
+            or args.metrics_snapshot:
+        from knn_tpu import obs
+
+        if not obs.enabled():
+            # the flags are an explicit telemetry request; a silent
+            # empty log/endpoint would read as a collection bug
+            print("warning: KNN_TPU_OBS=0 disables telemetry — "
+                  "--obs-log/--metrics-port/--metrics-snapshot will "
+                  "produce empty output", file=sys.stderr)
+        if args.obs_log:
+            obs.reset_event_log(args.obs_log)
+        if args.metrics_port is not None:
+            server = obs.start_metrics_server(args.metrics_port)
+            port = server.server_address[1]  # resolved when PORT was 0
+            print(f"metrics: http://127.0.0.1:{port}/metrics")
     from knn_tpu.pipeline import run_job  # deferred: JAX import is heavy
 
-    result = run_job(args_to_config(args))
+    try:
+        result = run_job(args_to_config(args))
+    finally:
+        if server is not None:
+            server.shutdown()
     if result.val_accuracy is not None:
         print(f"accuracy = {result.val_accuracy}")  # knn_mpi.cpp:348
     print(f"Running time is {result.total_time} second")  # knn_mpi.cpp:398
     if args.metrics_json:
         with open(args.metrics_json, "w") as f:
             f.write(result.metrics_json())
+    if args.metrics_snapshot:
+        from knn_tpu import obs
+
+        obs.write_json_snapshot(args.metrics_snapshot)
     return 0
 
 

@@ -1,0 +1,69 @@
+"""knn_tpu.obs — the unified telemetry subsystem.
+
+One registry, one event log, two exporters; everything else in the
+repo (serving, certified search, tuning, pipeline phases, JAX compiles)
+writes through here instead of keeping private ad-hoc counters:
+
+- **Metrics registry** (:mod:`knn_tpu.obs.registry`): process-wide,
+  thread-safe counters / gauges / bounded histograms with p50/p95/p99,
+  validated against the catalog (:mod:`knn_tpu.obs.names`).  Disabled
+  mode (``KNN_TPU_OBS=0``) hands out one shared no-op instrument —
+  near-zero cost, bitwise-identical results.
+- **Spans + events** (:mod:`knn_tpu.obs.trace`): request-scoped trace
+  ids minted at submit and propagated through micro-batching; a bounded
+  in-memory event ring plus an optional JSONL sink
+  (``KNN_TPU_OBS_LOG``).
+- **Exporters** (:mod:`knn_tpu.obs.export`): Prometheus text served
+  from a stdlib-HTTP endpoint (``--metrics-port``), an atomic JSON
+  snapshot writer, and ``python -m knn_tpu.cli metrics`` to read
+  either.
+- **Compile hook** (:mod:`knn_tpu.obs.jax_hooks`): every XLA compile's
+  count + seconds via ``jax.monitoring``.
+
+The package itself imports no JAX (jax_hooks defers it), so the CLI's
+flag parsing and the lint script stay import-light.
+
+Metric catalog, span lifecycle, and overhead numbers:
+``docs/OBSERVABILITY.md``.
+"""
+
+from knn_tpu.obs import names  # noqa: F401  (the catalog is public API)
+from knn_tpu.obs.export import (  # noqa: F401
+    compact_snapshot,
+    prometheus_text,
+    start_metrics_server,
+    write_json_snapshot,
+)
+from knn_tpu.obs.jax_hooks import install_compile_hook  # noqa: F401
+from knn_tpu.obs.registry import (  # noqa: F401
+    NOOP,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    enabled,
+    gauge,
+    get_registry,
+    histogram,
+    reset,
+    snapshot,
+)
+from knn_tpu.obs.trace import (  # noqa: F401
+    EventLog,
+    emit_event,
+    get_event_log,
+    new_trace_id,
+    record_span,
+    reset_event_log,
+    span,
+)
+
+__all__ = [
+    "NOOP", "Counter", "EventLog", "Gauge", "Histogram",
+    "MetricsRegistry", "compact_snapshot", "counter", "emit_event",
+    "enabled", "gauge", "get_event_log", "get_registry", "histogram",
+    "install_compile_hook", "names", "new_trace_id", "prometheus_text",
+    "record_span", "reset", "reset_event_log", "snapshot", "span",
+    "start_metrics_server", "write_json_snapshot",
+]

@@ -1,0 +1,153 @@
+"""Exporters: Prometheus text format, atomic JSON snapshots, and a
+stdlib-HTTP ``/metrics`` endpoint.
+
+Rendering rules (one source: :func:`prometheus_text` over
+``registry.snapshot()``):
+
+- counters/gauges render as ``name{labels} value``;
+- histograms render as Prometheus **summaries** — ``name{quantile="..."}``
+  lines from the bounded-window percentiles plus lifetime ``_sum`` and
+  ``_count`` (the window feeds quantiles, the lifetime pair feeds rate
+  math, so a scraper gets both truths).
+
+The HTTP server is intentionally boring: ``http.server`` threading
+daemon, ``/metrics`` (text format) + ``/metrics.json`` (the snapshot),
+no deps, no auth — bind it to localhost and let the scraper's side
+handle the rest.  The JSON snapshot writer is atomic (tmp + rename,
+the tuning-cache discipline) so a scraper of the file never reads a
+torn write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from knn_tpu.obs import registry
+
+#: summary quantiles exported from the histogram window
+_QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
+
+
+def _esc(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels_str(labels: dict, extra: Optional[tuple] = None) -> str:
+    items = sorted(labels.items())
+    if extra is not None:
+        items = items + [extra]
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(str(v))}"' for k, v in items) + "}"
+
+
+def prometheus_text(snapshot: Optional[dict] = None) -> str:
+    """The whole registry in Prometheus text exposition format."""
+    snap = registry.snapshot() if snapshot is None else snapshot
+    lines = []
+    for name in sorted(snap):
+        m = snap[name]
+        kind = m["type"]
+        prom_kind = "summary" if kind == "histogram" else kind
+        lines.append(f"# HELP {name} {m['help']}")
+        lines.append(f"# TYPE {name} {prom_kind}")
+        for s in m["series"]:
+            ls, v = s["labels"], s["value"]
+            if kind == "histogram":
+                for q, key in _QUANTILES:
+                    if key in v:
+                        lines.append(
+                            f"{name}{_labels_str(ls, ('quantile', q))} "
+                            f"{v[key]}")
+                lines.append(f"{name}_sum{_labels_str(ls)} {v['sum']}")
+                lines.append(f"{name}_count{_labels_str(ls)} {v['count']}")
+            else:
+                lines.append(f"{name}{_labels_str(ls)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def compact_snapshot(snapshot: Optional[dict] = None) -> dict:
+    """The snapshot flattened for embedding (JobResult.metrics()["obs"],
+    bench lines): ``{name: value}`` for unlabeled series, ``{name:
+    {"k=v,...": value}}`` for labeled ones; histograms keep their
+    summary dict."""
+    snap = registry.snapshot() if snapshot is None else snapshot
+    out: dict = {}
+    for name, m in snap.items():
+        series = m["series"]
+        if len(series) == 1 and not series[0]["labels"]:
+            out[name] = series[0]["value"]
+        else:
+            out[name] = {
+                ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items())):
+                    s["value"]
+                for s in series
+            }
+    return out
+
+
+def write_json_snapshot(path: str, snapshot: Optional[dict] = None) -> dict:
+    """Atomic JSON snapshot (tmp + rename): a scraper of the file can
+    never observe a torn write.  Returns the written payload."""
+    payload = {
+        "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "pid": os.getpid(),
+        "enabled": registry.enabled(),
+        "metrics": registry.snapshot() if snapshot is None else snapshot,
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return payload
+
+
+def start_metrics_server(port: int, host: str = "127.0.0.1"):
+    """Serve ``/metrics`` (Prometheus text) + ``/metrics.json`` (the
+    full snapshot) from a daemon thread; returns the server (``.shutdown()``
+    to stop; ``.server_address[1]`` for the bound port — pass port 0 to
+    let the OS pick one)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib handler contract
+            path = self.path.split("?", 1)[0]
+            if path in ("/metrics", "/"):
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics.json":
+                body = json.dumps(
+                    {"enabled": registry.enabled(),
+                     "metrics": registry.snapshot()},
+                    indent=1, sort_keys=True).encode()
+                ctype = "application/json"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # silence per-scrape stderr
+            pass
+
+    server = ThreadingHTTPServer((host, int(port)), Handler)
+    server.daemon_threads = True
+    t = threading.Thread(
+        target=server.serve_forever, name="knn-obs-metrics", daemon=True)
+    t.start()
+    return server

@@ -1,0 +1,66 @@
+"""JAX compile-event hook: count + seconds per compile, via
+``jax.monitoring``.
+
+JAX reports named durations (``/jax/core/compile`` and friends) through
+``jax.monitoring.record_event_duration_secs``; registering a listener is
+the supported way to observe every XLA compile in the process — inline
+jit compiles, AOT ``lower().compile()`` calls, and cache lookups alike —
+without wrapping any call site.  The listener filters for event keys
+containing ``compile`` and mirrors them into
+``knn_tpu_jax_compiles_total`` / ``knn_tpu_jax_compile_seconds_total``,
+labeled by the sanitized event key (a small, version-bounded set).
+
+:func:`install_compile_hook` is idempotent and safe to call from every
+instrumented entry point (engine construction, ``run_job``, the bench);
+it no-ops when the subsystem is disabled or the monitoring API is
+absent (older jaxlibs), so no caller needs a guard.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+from knn_tpu.obs import names, registry
+
+_lock = threading.Lock()
+_installed = False
+
+_SANITIZE = re.compile(r"[^a-z0-9_]+")
+
+
+def _event_label(key: str) -> str:
+    return _SANITIZE.sub("_", key.lower()).strip("_")
+
+
+def _on_duration(event: str, duration: float, **_kw) -> None:
+    # **_kw: newer jax versions pass extra keyword context; ignore it
+    if "compile" not in event:
+        return
+    try:
+        label = _event_label(event)
+        registry.counter(names.JAX_COMPILES, event=label).inc()
+        registry.counter(
+            names.JAX_COMPILE_SECONDS, event=label).inc(float(duration))
+    except Exception:  # noqa: BLE001 - a hook must never break compiles
+        pass
+
+
+def install_compile_hook() -> bool:
+    """Register the listener once per process; returns whether the hook
+    is (now) active."""
+    global _installed
+    if not registry.enabled():
+        return False
+    with _lock:
+        if _installed:
+            return True
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_duration)
+        except Exception:  # noqa: BLE001 - older jax: no monitoring API
+            return False
+        _installed = True
+        return True

@@ -1,0 +1,169 @@
+"""The metric catalog — ONE jax-free home for every metric name the
+registry may hand out.
+
+Every metric the subsystem can register is declared here, with its type,
+label names, and help string.  The registry REFUSES names outside this
+catalog (knn_tpu.obs.registry), and ``scripts/lint_metric_names.py``
+checks two invariants over it: every name matches ``knn_tpu_[a-z0-9_]+``
+and every name appears in the ``docs/OBSERVABILITY.md`` catalog table —
+so an instrumented code path can neither invent an undocumented metric
+nor document a phantom one.
+
+Names follow the Prometheus conventions the exporters assume: a
+``knn_tpu_`` namespace prefix, ``_total`` suffix on counters, ``_seconds``
+on time-valued metrics, base units throughout.
+"""
+
+from __future__ import annotations
+
+# --- serving engine (knn_tpu.serving.engine) ---------------------------
+SERVING_REQUESTS = "knn_tpu_serving_requests_total"
+SERVING_QUERIES = "knn_tpu_serving_queries_total"
+SERVING_ERRORS = "knn_tpu_serving_errors_total"
+SERVING_DISPATCHES = "knn_tpu_serving_dispatches_total"
+SERVING_COMPILES = "knn_tpu_serving_compiles_total"
+SERVING_REQUEST_LATENCY = "knn_tpu_serving_request_latency_seconds"
+
+# --- micro-batching queue (knn_tpu.serving.queue) ----------------------
+QUEUE_DEPTH_REQUESTS = "knn_tpu_queue_depth_requests"
+QUEUE_DEPTH_ROWS = "knn_tpu_queue_depth_rows"
+QUEUE_REQUESTS = "knn_tpu_queue_requests_total"
+QUEUE_DISPATCHES = "knn_tpu_queue_dispatches_total"
+QUEUE_COALESCED_ROWS = "knn_tpu_queue_coalesced_rows_total"
+QUEUE_ERRORS = "knn_tpu_queue_errors_total"
+QUEUE_WAIT = "knn_tpu_queue_wait_seconds"
+QUEUE_REQUEST_LATENCY = "knn_tpu_queue_request_latency_seconds"
+
+# --- certified search (knn_tpu.parallel.sharded) -----------------------
+CERTIFIED_QUERIES = "knn_tpu_certified_queries_total"
+CERTIFIED_FALLBACKS = "knn_tpu_certified_fallback_queries_total"
+CERTIFIED_GENUINE_MISSES = "knn_tpu_certified_fallback_genuine_misses_total"
+CERTIFIED_FALSE_ALARMS = "knn_tpu_certified_fallback_false_alarms_total"
+CERTIFIED_HOST_EXACT = "knn_tpu_certified_host_exact_queries_total"
+CERTIFIED_RANK_CORRECTED = "knn_tpu_certified_rank_corrected_queries_total"
+CERTIFIED_QUANT_BOUND = "knn_tpu_certified_quant_bound"
+
+# --- autotuner (knn_tpu.tuning) ----------------------------------------
+TUNING_RESOLVES = "knn_tpu_tuning_resolve_total"
+TUNING_CACHE_HITS = "knn_tpu_tuning_cache_hits_total"
+TUNING_CACHE_MISSES = "knn_tpu_tuning_cache_misses_total"
+TUNING_SEARCHES = "knn_tpu_tuning_searches_total"
+TUNING_CANDIDATES_TIMED = "knn_tpu_tuning_candidates_timed_total"
+TUNING_GATE_FAILURES = "knn_tpu_tuning_gate_failures_total"
+
+# --- JAX compile events (knn_tpu.obs.jax_hooks) ------------------------
+JAX_COMPILES = "knn_tpu_jax_compiles_total"
+JAX_COMPILE_SECONDS = "knn_tpu_jax_compile_seconds_total"
+
+# --- pipeline / spans (knn_tpu.utils.timing, knn_tpu.obs.trace) --------
+PHASE_SECONDS = "knn_tpu_phase_seconds"
+SPAN_SECONDS = "knn_tpu_span_seconds"
+EVENTS_DROPPED = "knn_tpu_events_dropped_total"
+
+#: name -> (type, label names, help).  Types: "counter" (monotone,
+#: float-valued so second-counters work), "gauge", "histogram" (bounded
+#: sample window + lifetime count/sum; exported as a Prometheus summary).
+CATALOG = {
+    SERVING_REQUESTS: (
+        "counter", ("op",),
+        "Lifetime requests accepted by ServingEngine.submit()."),
+    SERVING_QUERIES: (
+        "counter", ("op",),
+        "Lifetime query rows accepted by ServingEngine.submit()."),
+    SERVING_ERRORS: (
+        "counter", ("op",),
+        "Requests that raised through dispatch or result join."),
+    SERVING_DISPATCHES: (
+        "counter", ("op", "bucket"),
+        "Bucketed chunk dispatches, by op and bucket rung."),
+    SERVING_COMPILES: (
+        "counter", ("op", "bucket"),
+        "Executable builds per (op, bucket) — the bucket ladder's "
+        "compile-bound proof."),
+    SERVING_REQUEST_LATENCY: (
+        "histogram", ("op",),
+        "Arrival-to-result request latency through the engine (seconds)."),
+    QUEUE_DEPTH_REQUESTS: (
+        "gauge", (),
+        "Requests currently waiting in the micro-batching queue."),
+    QUEUE_DEPTH_ROWS: (
+        "gauge", (),
+        "Query rows currently waiting in the micro-batching queue."),
+    QUEUE_REQUESTS: (
+        "counter", (),
+        "Lifetime requests accepted by QueryQueue.submit()."),
+    QUEUE_DISPATCHES: (
+        "counter", (),
+        "Coalesced batches the queue dispatched to the engine."),
+    QUEUE_COALESCED_ROWS: (
+        "counter", (),
+        "Query rows dispatched through coalesced batches."),
+    QUEUE_ERRORS: (
+        "counter", (),
+        "Queued requests resolved with an exception."),
+    QUEUE_WAIT: (
+        "histogram", (),
+        "Per-request wait from arrival to batch dispatch (seconds)."),
+    QUEUE_REQUEST_LATENCY: (
+        "histogram", (),
+        "Per-request arrival-to-result latency through the queue "
+        "(seconds) — includes the micro-batching wait."),
+    CERTIFIED_QUERIES: (
+        "counter", ("selector",),
+        "Queries processed by ShardedKNN.search_certified."),
+    CERTIFIED_FALLBACKS: (
+        "counter", ("selector",),
+        "Queries that failed certification and took the widened "
+        "re-select fallback."),
+    CERTIFIED_GENUINE_MISSES: (
+        "counter", ("selector",),
+        "Fallbacks where the repair CHANGED the answer (the coarse pass "
+        "really missed a neighbor)."),
+    CERTIFIED_FALSE_ALARMS: (
+        "counter", ("selector",),
+        "Fallbacks that reproduced the original answer (the tolerance "
+        "cried wolf)."),
+    CERTIFIED_HOST_EXACT: (
+        "counter", ("selector",),
+        "Fallbacks escalated to the unconditional float64 host scan."),
+    CERTIFIED_RANK_CORRECTED: (
+        "counter", (),
+        "Pallas-selector queries whose near-tie runs were re-ranked in "
+        "float64."),
+    CERTIFIED_QUANT_BOUND: (
+        "histogram", (),
+        "Per-query int8 certified quantization error bound epsilon "
+        "(score units) — the quality signal the int8 coarse pass "
+        "computes."),
+    TUNING_RESOLVES: (
+        "counter", (), "tuning.resolve() invocations."),
+    TUNING_CACHE_HITS: (
+        "counter", (), "Knob resolutions served from the persisted "
+        "winner cache."),
+    TUNING_CACHE_MISSES: (
+        "counter", (), "Knob resolutions that fell back to defaults."),
+    TUNING_SEARCHES: (
+        "counter", (), "autotune() runs that actually searched the "
+        "grid."),
+    TUNING_CANDIDATES_TIMED: (
+        "counter", (), "Autotuner candidates built and timed (0 on a "
+        "warm cache)."),
+    TUNING_GATE_FAILURES: (
+        "counter", (), "Autotuner candidates rejected by the bitwise "
+        "end-result gate."),
+    JAX_COMPILES: (
+        "counter", ("event",),
+        "JAX/XLA compile events observed via jax.monitoring."),
+    JAX_COMPILE_SECONDS: (
+        "counter", ("event",),
+        "Cumulative seconds spent in observed JAX/XLA compile events."),
+    PHASE_SECONDS: (
+        "histogram", ("phase",),
+        "PhaseTimer phase durations (seconds), by phase name."),
+    SPAN_SECONDS: (
+        "histogram", ("span",),
+        "Trace span durations (seconds), by span name."),
+    EVENTS_DROPPED: (
+        "counter", (),
+        "Structured events dropped because the JSONL sink raised."),
+}

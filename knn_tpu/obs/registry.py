@@ -1,0 +1,330 @@
+"""Process-wide, thread-safe metrics registry.
+
+Three instrument kinds (ops.metrics is the DISTANCE metric table; this
+module is the observability one):
+
+- :class:`Counter` — monotone float (float so second-counters like
+  ``knn_tpu_jax_compile_seconds_total`` fit the same type),
+- :class:`Gauge` — settable level,
+- :class:`Histogram` — lifetime count/sum/min/max plus a BOUNDED sample
+  window feeding p50/p95/p99 (a long-running service must not grow a
+  per-observation list forever; the window percentiles are the
+  operationally useful number, exactly serving.latency_summary's
+  argument).
+
+Every name must come from the catalog (knn_tpu.obs.names.CATALOG) with
+matching label names — undocumented metrics are unregisterable by
+construction, which is what lets ``scripts/lint_metric_names.py`` prove
+the docs/OBSERVABILITY.md catalog complete.
+
+Disabled mode (``KNN_TPU_OBS=0``): :func:`get_registry` returns a
+no-op registry whose ``counter``/``gauge``/``histogram`` hand back ONE
+shared do-nothing instrument — no allocation, no locking, no state —
+so instrumented hot paths cost a dict-free method call and nothing
+else, and results stay bitwise identical either way (instrumentation
+never touches numerics; tests/test_obs.py pins both properties).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+from knn_tpu.obs.names import CATALOG
+
+#: the shape every registrable metric name must have (also enforced by
+#: scripts/lint_metric_names.py over the catalog itself)
+NAME_RE = re.compile(r"^knn_tpu_[a-z0-9_]+$")
+
+#: env switch: "0"/"false"/"off" disables the whole subsystem (default on)
+OBS_ENV = "KNN_TPU_OBS"
+
+#: bounded histogram window (samples per labeled series)
+DEFAULT_WINDOW = 4096
+
+
+class Counter:
+    """Monotone counter; ``inc`` only (negative increments refused)."""
+
+    __slots__ = ("_lock", "_v")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter increment must be >= 0, got {amount}")
+        with self._lock:
+            self._v += amount
+
+    def get(self) -> float:
+        with self._lock:
+            return self._v
+
+
+class Gauge:
+    """Settable level; ``set``/``inc``/``dec``."""
+
+    __slots__ = ("_lock", "_v")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._v = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v -= amount
+
+    def get(self) -> float:
+        with self._lock:
+            return self._v
+
+
+class Histogram:
+    """Lifetime count/sum/min/max + a bounded recent-sample window the
+    percentiles are computed over (see module docstring)."""
+
+    __slots__ = ("_lock", "_count", "_sum", "_min", "_max", "_window")
+
+    def __init__(self, window: int = DEFAULT_WINDOW):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._window: deque = deque(maxlen=int(window))
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+            self._window.append(v)
+
+    def observe_many(self, values) -> None:
+        """Bulk observe (one lock acquisition) — the int8 quant-bound
+        path records a whole query batch's epsilons at once."""
+        vs = [float(v) for v in values]
+        if not vs:
+            return
+        lo, hi = min(vs), max(vs)
+        with self._lock:
+            self._count += len(vs)
+            self._sum += sum(vs)
+            if self._min is None or lo < self._min:
+                self._min = lo
+            if self._max is None or hi > self._max:
+                self._max = hi
+            self._window.extend(vs)
+
+    def get(self) -> Dict[str, float]:
+        return self.summary()
+
+    def summary(self) -> Dict[str, float]:
+        """Lifetime count/sum/min/max + window p50/p95/p99/mean."""
+        with self._lock:
+            count, total = self._count, self._sum
+            mn, mx = self._min, self._max
+            window = list(self._window)
+        out: Dict[str, float] = {"count": count, "sum": total}
+        if mn is not None:
+            out["min"], out["max"] = mn, mx
+        if window:
+            # numpy only when there are samples: keeps the empty-series
+            # snapshot path import-light
+            import numpy as np
+
+            arr = np.asarray(window, dtype=np.float64)
+            out.update({
+                "p50": float(np.percentile(arr, 50)),
+                "p95": float(np.percentile(arr, 95)),
+                "p99": float(np.percentile(arr, 99)),
+                "mean": float(arr.mean()),
+                "window": int(arr.size),
+            })
+        return out
+
+
+class _Noop:
+    """The shared disabled-mode instrument: every method of every kind,
+    doing nothing.  ONE instance (``NOOP``) serves all call sites — the
+    no-op identity tests/test_obs.py pins."""
+
+    __slots__ = ()
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def dec(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def observe_many(self, values) -> None:
+        pass
+
+    def get(self):
+        return 0.0
+
+    def summary(self) -> dict:
+        return {"count": 0, "sum": 0.0}
+
+
+NOOP = _Noop()
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Catalog-validated instrument store, keyed (name, label items)."""
+
+    def __init__(self, *, window: int = DEFAULT_WINDOW):
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
+        self._window = int(window)
+
+    # -- registration ------------------------------------------------------
+    def _get(self, kind: str, name: str, labels: Dict[str, object]):
+        spec = CATALOG.get(name)
+        if spec is None or not NAME_RE.match(name):
+            raise ValueError(
+                f"metric {name!r} is not in the catalog "
+                f"(knn_tpu.obs.names.CATALOG) — declare it there, with "
+                f"docs, before instrumenting")
+        want_kind, want_labels, _help = spec
+        if want_kind != kind:
+            raise ValueError(
+                f"metric {name!r} is a {want_kind}, not a {kind}")
+        if tuple(sorted(labels)) != tuple(sorted(want_labels)):
+            raise ValueError(
+                f"metric {name!r} takes labels {sorted(want_labels)}, "
+                f"got {sorted(labels)}")
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        with self._lock:
+            inst = self._series.get(key)
+            if inst is None:
+                inst = (_KINDS[kind](window=self._window)
+                        if kind == "histogram" else _KINDS[kind]())
+                self._series[key] = inst
+            return inst
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get("histogram", name, labels)
+
+    # -- inspection --------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Every registered series, catalog metadata included — the ONE
+        structure both exporters (Prometheus text, JSON file) render."""
+        with self._lock:
+            keys = list(self._series.items())
+        out: dict = {}
+        for (name, label_items), inst in keys:
+            kind, _labels, help_ = CATALOG[name]
+            m = out.setdefault(
+                name, {"type": kind, "help": help_, "series": []})
+            value = inst.summary() if kind == "histogram" else inst.get()
+            m["series"].append({"labels": dict(label_items), "value": value})
+        for m in out.values():  # deterministic export order
+            m["series"].sort(key=lambda s: sorted(s["labels"].items()))
+        return out
+
+
+class _NoopRegistry(MetricsRegistry):
+    """Disabled mode: every instrument request returns the ONE shared
+    no-op after the same catalog validation (so a bad name fails fast in
+    dev regardless of the env switch)."""
+
+    def _get(self, kind, name, labels):
+        spec = CATALOG.get(name)
+        if (spec is not None and spec[0] == kind
+                and tuple(sorted(labels)) == tuple(sorted(spec[1]))):
+            return NOOP
+        # invalid request: delegate for the precise error message (the
+        # parent raises before it would ever allocate an instrument)
+        return super()._get(kind, name, labels)
+
+    def snapshot(self) -> dict:
+        return {}
+
+
+_state_lock = threading.Lock()
+_registry: Optional[MetricsRegistry] = None
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(OBS_ENV, "1").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def enabled() -> bool:
+    """Whether the subsystem is live (resolved once, at first registry
+    access; flip with :func:`reset`)."""
+    return not isinstance(get_registry(), _NoopRegistry)
+
+
+def get_registry() -> MetricsRegistry:
+    global _registry
+    reg = _registry
+    if reg is None:
+        with _state_lock:
+            if _registry is None:
+                _registry = (MetricsRegistry() if _env_enabled()
+                             else _NoopRegistry())
+            reg = _registry
+    return reg
+
+
+def reset(enabled: Optional[bool] = None) -> MetricsRegistry:
+    """Swap in a fresh registry (clears every series); ``enabled`` None
+    re-reads the env.  Tests use this for isolation; production code
+    never needs it.  Note instruments handed out by the OLD registry
+    keep working but stop being exported — re-fetch handles after a
+    reset."""
+    global _registry
+    with _state_lock:
+        want = _env_enabled() if enabled is None else bool(enabled)
+        _registry = MetricsRegistry() if want else _NoopRegistry()
+        return _registry
+
+
+# -- convenience pass-throughs (the instrumented modules' whole API) -----
+def counter(name: str, **labels) -> Counter:
+    return get_registry().counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return get_registry().gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> Histogram:
+    return get_registry().histogram(name, **labels)
+
+
+def snapshot() -> dict:
+    return get_registry().snapshot()

@@ -1,0 +1,194 @@
+"""Request-scoped trace spans + the structured JSONL event log.
+
+A **trace id** is minted where a request enters the system
+(``ServingEngine.submit`` for direct callers, ``QueryQueue.submit`` for
+queued ones) and rides the request through micro-batching, dispatch,
+and result join — so ONE request's queue-wait / compile / device / join
+times are attributable end-to-end even when the request was coalesced
+into a batch with strangers (each batch member keeps its own id; the
+batch dispatch event lists the member ids it carried).
+
+A **span** is a timed scope: ``with span("serving.dispatch",
+trace_id=tid, op="search"):`` records wall duration into the
+``knn_tpu_span_seconds{span=...}`` histogram and emits one structured
+event.  Events land in a bounded in-memory ring (always, when enabled)
+and, when ``KNN_TPU_OBS_LOG`` names a path, as JSON lines on disk —
+machine-scrapable, one object per line, append-only.
+
+Disabled mode (``KNN_TPU_OBS=0``): :func:`span` yields a shared inert
+span, :func:`new_trace_id` returns None, and :func:`emit_event` drops —
+zero allocation on the hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Optional
+
+from knn_tpu.obs import names, registry
+
+#: env var naming the JSONL sink (unset = in-memory ring only)
+LOG_ENV = "KNN_TPU_OBS_LOG"
+
+#: in-memory event ring size — enough to hold a serving trace's worth of
+#: spans for tests/debugging without unbounded growth
+RING_SIZE = 8192
+
+
+def new_trace_id() -> Optional[str]:
+    """A 16-hex-char request id, or None when the subsystem is off (so
+    propagation sites can thread it unconditionally)."""
+    if not registry.enabled():
+        return None
+    return uuid.uuid4().hex[:16]
+
+
+class EventLog:
+    """Bounded ring + optional JSONL file sink.  ``emit`` is thread-safe
+    and never raises into the instrumented path: a failing sink counts
+    ``knn_tpu_events_dropped_total`` instead."""
+
+    def __init__(self, path: Optional[str] = None, ring: int = RING_SIZE):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(ring))
+        self._path = path
+        self._fh = None
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def emit(self, event: dict) -> None:
+        evt = {"ts": round(time.time(), 6), **event}
+        # serialize OUTSIDE the lock: concurrent serving threads must
+        # contend only for the append/write, not for json encoding
+        line = json.dumps(evt) + "\n" if self._path is not None else None
+        with self._lock:
+            self._ring.append(evt)
+            if line is not None:
+                try:
+                    if self._fh is None:
+                        self._fh = open(self._path, "a")
+                    self._fh.write(line)
+                    self._fh.flush()
+                except OSError:
+                    registry.counter(names.EVENTS_DROPPED).inc()
+
+    def recent(self, n: Optional[int] = None) -> list:
+        """Newest-last copy of the ring (``n`` trailing events)."""
+        with self._lock:
+            evts = list(self._ring)
+        return evts if n is None else evts[-n:]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+_state_lock = threading.Lock()
+_log: Optional[EventLog] = None
+
+
+def get_event_log() -> EventLog:
+    global _log
+    log = _log
+    if log is None:
+        with _state_lock:
+            if _log is None:
+                _log = EventLog(os.environ.get(LOG_ENV) or None)
+            log = _log
+    return log
+
+
+def reset_event_log(path: Optional[str] = None,
+                    from_env: bool = False) -> EventLog:
+    """Swap in a fresh event log (tests; ``from_env`` re-reads
+    ``KNN_TPU_OBS_LOG``)."""
+    global _log
+    with _state_lock:
+        if _log is not None:
+            _log.close()
+        _log = EventLog(
+            os.environ.get(LOG_ENV) or None if from_env else path)
+        return _log
+
+
+def emit_event(name: str, **fields) -> None:
+    """One structured event (non-span), dropped when disabled."""
+    if not registry.enabled():
+        return
+    get_event_log().emit({"type": "event", "name": name, **fields})
+
+
+class Span:
+    """A live span: mutate ``attrs`` (via :meth:`set`) before the scope
+    closes and the attributes ride the emitted event."""
+
+    __slots__ = ("name", "trace_id", "attrs")
+
+    def __init__(self, name: str, trace_id: Optional[str], attrs: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.attrs = attrs
+
+    def set(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+
+class _NoopSpan:
+    __slots__ = ()
+    name = None
+    trace_id = None
+
+    def set(self, key: str, value) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def record_span(name: str, trace_id: Optional[str], dur_s: float,
+                **attrs) -> None:
+    """Record an already-measured span (the engine's latency join points
+    measure durations themselves): histogram observe + one event."""
+    if not registry.enabled():
+        return
+    registry.histogram(names.SPAN_SECONDS, span=name).observe(dur_s)
+    evt = {"type": "span", "span": name, "dur_s": round(dur_s, 6), **attrs}
+    if trace_id is not None:
+        evt["trace_id"] = trace_id
+    get_event_log().emit(evt)
+
+
+@contextlib.contextmanager
+def span(name: str, trace_id: Optional[str] = None, **attrs):
+    """Timed scope -> ``knn_tpu_span_seconds{span=name}`` + one event.
+    Yields the :class:`Span` (``.trace_id``, ``.set``); disabled mode
+    yields the shared inert span and records nothing.
+
+    ``trace_id`` is PROPAGATED, never minted here: ids are created where
+    a request enters the system (``new_trace_id()`` at the submit
+    sites), so a span without one (a warmup compile, a background task)
+    emits without a trace_id field instead of fabricating a phantom
+    single-span request."""
+    if not registry.enabled():
+        yield NOOP_SPAN
+        return
+    sp = Span(name, trace_id, dict(attrs))
+    t0 = time.perf_counter()
+    try:
+        yield sp
+    finally:
+        record_span(name, sp.trace_id, time.perf_counter() - t0,
+                    **sp.attrs)

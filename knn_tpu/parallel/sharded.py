@@ -39,6 +39,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from knn_tpu import obs
+from knn_tpu.obs import names as _mn
 from knn_tpu.ops.normalize import local_minmax, minmax_apply
 from knn_tpu.ops.topk import knn_search_tiled, merge_topk, topk_pairs
 from knn_tpu.ops.vote import majority_vote
@@ -352,6 +354,10 @@ class ShardedKNN:
     ):
         if merge not in _MERGES:
             raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
+        # XLA compile events (count + seconds) from every program this
+        # placement builds land in the registry; idempotent, no-op when
+        # telemetry is off
+        obs.install_compile_hook()
         metric = metric.lower()  # dispatch below compares lowercase names
         self._cosine_unit = False  # db rows normalized at placement?
         #: uint8 source rows (SIFT-style bvecs payloads): kept so an int8
@@ -964,6 +970,20 @@ class ShardedKNN:
             stats["rank_corrected_queries"] = n_corrected
             stats["pallas_knobs"] = knobs
             stats["tuning"] = tune_info
+        # mirror the quality signals into the telemetry registry — the
+        # per-call stats dict stays the API, the registry accumulates the
+        # process-lifetime truth a scraper reads (docs/OBSERVABILITY.md)
+        obs.counter(_mn.CERTIFIED_QUERIES, selector=selector).inc(n_q)
+        obs.counter(_mn.CERTIFIED_FALLBACKS, selector=selector).inc(
+            int(bad.size))
+        obs.counter(_mn.CERTIFIED_GENUINE_MISSES, selector=selector).inc(
+            repair.get("fallback_genuine_misses", 0))
+        obs.counter(_mn.CERTIFIED_FALSE_ALARMS, selector=selector).inc(
+            repair.get("fallback_false_alarms", 0))
+        obs.counter(_mn.CERTIFIED_HOST_EXACT, selector=selector).inc(
+            repair.get("host_exact_queries", 0))
+        if selector == "pallas":
+            obs.counter(_mn.CERTIFIED_RANK_CORRECTED).inc(n_corrected)
         if return_distances and self.metric == "cosine":
             # unit-vector squared L2 -> cosine distance values, exactly
             # (matches pairwise_cosine's 1 - similarity convention)
@@ -1182,6 +1202,19 @@ class ShardedKNN:
         # tail is precision-shaped (int8: the quantized placement; f32:
         # the scalar norm bound) — ONE home, _pallas_operands
         ops_tail = self._pallas_operands(precision)
+        if precision == "int8" and obs.enabled():
+            # the per-query certified quantization bound ε — the quality
+            # signal the device certificate computes and discards
+            # (quantize.score_error_bound_device): recomputed host-side
+            # (O(Q·D), noise next to the O(Q·N·D) sweep) and recorded as
+            # a distribution so a scraper sees how tight the int8 bound
+            # ran, not just the bench's one max
+            from knn_tpu.ops.quantize import score_error_bound
+
+            pl8 = self._int8_placement()
+            eps = score_error_bound(q_np, pl8["stats"],
+                                    offset=pl8["offset"])
+            obs.histogram(_mn.CERTIFIED_QUANT_BOUND).observe_many(eps)
         outs = []
         for lo, chunk, pad in batches:
             qp, _ = self._place_queries(chunk)

@@ -74,6 +74,15 @@ class JobResult:
             out["certified_stats"] = self.certified_stats
         if self.serving_stats is not None:
             out["serving"] = self.serving_stats
+        # the unified telemetry view (knn_tpu.obs): phase histograms,
+        # compile events, certified quality counters, serving series —
+        # everything above is a per-run slice; this is the process-wide
+        # registry the exporters scrape.  Absent when KNN_TPU_OBS=0, so
+        # pre-obs consumers see the exact shape they always did.
+        from knn_tpu import obs
+
+        if obs.enabled():
+            out["obs"] = obs.compact_snapshot()
         return out
 
     def metrics_json(self) -> str:
@@ -243,6 +252,9 @@ def _run_native(cfg: JobConfig, timer: PhaseTimer, train, train_labels, test, va
 def run_job(cfg: JobConfig, *, mesh=None) -> JobResult:
     """Run the full reference job under ``cfg``; returns what the reference
     prints/writes plus per-phase timings and throughput."""
+    from knn_tpu import obs
+
+    obs.install_compile_hook()  # count+seconds of every XLA compile
     timer = PhaseTimer()
 
     with timer.phase("ingest"):

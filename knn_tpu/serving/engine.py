@@ -41,6 +41,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from knn_tpu import obs
+from knn_tpu.obs import names as mn
 from knn_tpu.serving.buckets import (
     DEFAULT_MAX_BUCKET,
     DEFAULT_MIN_BUCKET,
@@ -76,37 +78,58 @@ class PendingSearch:
     asynchronously; :meth:`result` blocks on the transfer, slices the pad
     rows away, and records the request's wall latency."""
 
-    def __init__(self, engine: "ServingEngine", op: str, chunks, n: int, t0: float):
+    def __init__(self, engine: "ServingEngine", op: str, chunks, n: int,
+                 t0: float, trace_id: Optional[str] = None):
         self._engine = engine
         self._op = op
         self._chunks = chunks  # [(device outputs, redo, rows)]
         self._n = n
         self._t0 = t0
         self._done = False
+        self._error_counted = False
+        #: request-scoped trace id (minted in submit; None when obs off)
+        self.trace_id = trace_id
 
     def result(self):
         from knn_tpu.parallel.sharded import _fetch_or_redispatch
 
-        parts = []
-        for out, redo, rows in self._chunks:
+        t_join = time.perf_counter()
+        try:
+            parts = []
+            for out, redo, rows in self._chunks:
+                if self._op == "search":
+                    d = _fetch_or_redispatch(
+                        out[0], lambda r=redo: r()[0], "serving fetch (d)")
+                    i = _fetch_or_redispatch(
+                        out[1], lambda r=redo: r()[1], "serving fetch (i)")
+                    parts.append((d[:rows], i[:rows]))
+                else:
+                    lbl = _fetch_or_redispatch(out, redo, "serving fetch (labels)")
+                    parts.append(lbl[:rows])
             if self._op == "search":
-                d = _fetch_or_redispatch(
-                    out[0], lambda r=redo: r()[0], "serving fetch (d)")
-                i = _fetch_or_redispatch(
-                    out[1], lambda r=redo: r()[1], "serving fetch (i)")
-                parts.append((d[:rows], i[:rows]))
+                d = np.concatenate([p[0] for p in parts])[: self._n]
+                i = np.concatenate([p[1] for p in parts])[: self._n]
+                res = (d, i)
             else:
-                lbl = _fetch_or_redispatch(out, redo, "serving fetch (labels)")
-                parts.append(lbl[:rows])
-        if self._op == "search":
-            d = np.concatenate([p[0] for p in parts])[: self._n]
-            i = np.concatenate([p[1] for p in parts])[: self._n]
-            res = (d, i)
-        else:
-            res = np.concatenate(parts)[: self._n]
+                res = np.concatenate(parts)[: self._n]
+        except Exception:
+            # errors, like latency, count once per REQUEST: a caller
+            # retrying result() after a failure must not inflate
+            # errors_total on every attempt
+            if not self._error_counted:
+                self._error_counted = True
+                self._engine._record_error(self._op)
+            raise
         if not self._done:  # latency is per request, not per .result() call
             self._done = True
-            self._engine._record_latency(time.perf_counter() - self._t0)
+            done = time.perf_counter()
+            # join = time blocked on the device/transfer inside result();
+            # the request span is the full submit-to-result wall
+            obs.record_span("serving.join", self.trace_id,
+                            done - t_join, op=self._op)
+            self._engine._record_latency(done - self._t0, self._op,
+                                         trace_id=self.trace_id,
+                                         rows=self._n)
         return res
 
 
@@ -154,11 +177,21 @@ class ServingEngine:
         self._compiling: Dict[Tuple[str, int], threading.Event] = {}
         self._compiles: Counter = Counter()  # bucket -> compile count
         self._dispatches: Counter = Counter()  # bucket -> dispatch count
+        #: LIFETIME totals — the bounded latency window below reports
+        #: recent-window truth only, so a long-running engine needs these
+        #: to report lifetime truth alongside (also mirrored to the obs
+        #: registry: knn_tpu_serving_{requests,queries,errors}_total)
         self._requests = 0
+        self._queries = 0
+        self._errors = 0
         #: bounded sample window: a long-running service must not grow a
         #: per-request list forever, and stats() percentiles over the
-        #: recent window are the operationally useful number anyway
+        #: recent window are the operationally useful number anyway —
+        #: lifetime counts live in requests_total/queries_total above
         self._latencies_s: deque = deque(maxlen=int(latency_window))
+        # every XLA compile this engine triggers lands in the registry
+        # (count + seconds), not just the per-bucket tallies above
+        obs.install_compile_hook()
 
     # -- compile cache -----------------------------------------------------
     def _jit_fn(self, op: str):
@@ -189,7 +222,8 @@ class ServingEngine:
         p = self.program
         return (p._tp,) if op == "search" else (p._tp, p._labels)
 
-    def _executable(self, op: str, bucket: int):
+    def _executable(self, op: str, bucket: int,
+                    trace_id: Optional[str] = None):
         """The compiled executable for ``(op, bucket)``; compiles AOT on
         first use (``lower().compile()`` — no example batch is executed).
         Distinct buckets below the mesh's query-shard count share one
@@ -218,24 +252,30 @@ class ServingEngine:
                     break  # this thread owns the compile
             ev.wait()  # another thread is compiling this key; re-check
         try:
-            fn = self._jit_fn(op)
-            if self._aot:
-                q_spec = jax.ShapeDtypeStruct(
-                    (key[1], self._dim), np.float32,
-                    sharding=NamedSharding(self.program.mesh, P(QUERY_AXIS)),
-                )
-                try:
-                    ex = fn.lower(q_spec, *self._tail_args(op)).compile()
-                except Exception:
-                    # AOT API drift: fall back to the plain jitted callable
-                    # (still exactly one compile per placed shape, paid on
-                    # the first dispatch instead of here)
+            # the compile span carries the trace id of the request that
+            # triggered it (None for warmup), so a live request's inline
+            # compile stall is attributable to that request end-to-end
+            with obs.span("serving.compile", trace_id=trace_id, op=op,
+                          bucket=int(bucket), placed_rows=int(key[1])):
+                fn = self._jit_fn(op)
+                if self._aot:
+                    q_spec = jax.ShapeDtypeStruct(
+                        (key[1], self._dim), np.float32,
+                        sharding=NamedSharding(self.program.mesh, P(QUERY_AXIS)),
+                    )
+                    try:
+                        ex = fn.lower(q_spec, *self._tail_args(op)).compile()
+                    except Exception:
+                        # AOT API drift: fall back to the plain jitted callable
+                        # (still exactly one compile per placed shape, paid on
+                        # the first dispatch instead of here)
+                        ex = fn
+                else:
                     ex = fn
-            else:
-                ex = fn
             with self._lock:
                 self._execs[key] = ex
                 self._compiles[bucket] += 1
+            obs.counter(mn.SERVING_COMPILES, op=op, bucket=bucket).inc()
             return ex
         finally:
             # waiters re-check _execs; on a raised _jit_fn error they
@@ -274,7 +314,8 @@ class ServingEngine:
         return counts
 
     # -- dispatch ----------------------------------------------------------
-    def _dispatch_chunk(self, op: str, chunk: np.ndarray):
+    def _dispatch_chunk(self, op: str, chunk: np.ndarray,
+                        trace_id: Optional[str] = None):
         """Pad one <=max_bucket chunk to its bucket and dispatch (async).
         Returns (device outputs, redo closure, real row count)."""
         from knn_tpu.parallel.sharded import _retry_transient
@@ -292,17 +333,22 @@ class ServingEngine:
             # re-place on every attempt: with donation the previous
             # placement's buffer is consumed by the failed dispatch
             qp, _ = self.program._place_queries(padded)
-            return self._executable(op, bucket)(qp, *self._tail_args(op))
+            return self._executable(op, bucket, trace_id)(
+                qp, *self._tail_args(op))
 
         out = _retry_transient(go, "serving dispatch")
         with self._lock:
             self._dispatches[bucket] += 1
+        obs.counter(mn.SERVING_DISPATCHES, op=op, bucket=bucket).inc()
         return out, go, n
 
-    def submit(self, queries, *, op: str = "search") -> PendingSearch:
+    def submit(self, queries, *, op: str = "search",
+               trace_id: Optional[str] = None) -> PendingSearch:
         """Dispatch ``queries`` (async) and return a handle; oversize
         requests split into max-bucket chunks, each dispatched back to
-        back so the device pipeline stays full."""
+        back so the device pipeline stays full.  ``trace_id`` scopes the
+        request's spans (dispatch / compile / join); None mints a fresh
+        one when telemetry is enabled (knn_tpu.obs)."""
         if op not in OPS:
             raise ValueError(f"unknown op {op!r}; expected one of {OPS}")
         q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
@@ -310,15 +356,27 @@ class ServingEngine:
             raise ValueError(
                 f"queries shape {q.shape} incompatible with database dim "
                 f"{self._dim}")
+        if trace_id is None:
+            trace_id = obs.new_trace_id()
         t0 = time.perf_counter()
-        chunks = []
-        lo = 0
-        for size in split_sizes(q.shape[0], self.buckets[-1]):
-            chunks.append(self._dispatch_chunk(op, q[lo : lo + size]))
-            lo += size
+        try:
+            with obs.span("serving.dispatch", trace_id=trace_id, op=op,
+                          rows=int(q.shape[0])):
+                chunks = []
+                lo = 0
+                for size in split_sizes(q.shape[0], self.buckets[-1]):
+                    chunks.append(
+                        self._dispatch_chunk(op, q[lo : lo + size], trace_id))
+                    lo += size
+        except Exception:
+            self._record_error(op)
+            raise
         with self._lock:
             self._requests += 1
-        return PendingSearch(self, op, chunks, q.shape[0], t0)
+            self._queries += int(q.shape[0])
+        obs.counter(mn.SERVING_REQUESTS, op=op).inc()
+        obs.counter(mn.SERVING_QUERIES, op=op).inc(int(q.shape[0]))
+        return PendingSearch(self, op, chunks, q.shape[0], t0, trace_id)
 
     def search(self, queries, *, return_sqrt: bool = False):
         """Bucketed exact search: (distances [Q, k], indices [Q, k]) as
@@ -371,9 +429,24 @@ class ServingEngine:
         return results, report
 
     # -- observability -----------------------------------------------------
-    def _record_latency(self, seconds: float) -> None:
+    def _record_latency(self, seconds: float, op: str = "search", *,
+                        trace_id: Optional[str] = None,
+                        rows: Optional[int] = None) -> None:
         with self._lock:
             self._latencies_s.append(seconds)
+        # the registry histogram is the machine-scrapable counterpart of
+        # stats()["latency_ms"]: every sample feeds both, but each keeps
+        # its own bounded percentile window (latency_window here, the
+        # registry default there), so quantiles can differ when the
+        # engine was built with a non-default window
+        obs.histogram(mn.SERVING_REQUEST_LATENCY, op=op).observe(seconds)
+        obs.record_span("serving.request", trace_id, seconds, op=op,
+                        **({} if rows is None else {"rows": int(rows)}))
+
+    def _record_error(self, op: str) -> None:
+        with self._lock:
+            self._errors += 1
+        obs.counter(mn.SERVING_ERRORS, op=op).inc()
 
     def _tuning_info(self) -> Optional[dict]:
         """Resolved kernel knobs + provenance for this placement's shape
@@ -419,6 +492,12 @@ class ServingEngine:
                     int(b): int(c) for b, c in sorted(self._dispatches.items())
                 },
                 "requests": self._requests,
+                # lifetime truth, alongside the window percentiles: the
+                # latency deque is bounded, so on a long-running engine
+                # latency_ms["count"] is the window fill, NOT the total
+                "requests_total": self._requests,
+                "queries_total": self._queries,
+                "errors_total": self._errors,
                 "donate_queries": self.donate_queries,
                 "latency_ms": latency_summary(self._latencies_s),
             }

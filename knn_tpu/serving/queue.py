@@ -30,6 +30,9 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from knn_tpu import obs
+from knn_tpu.obs import names as mn
+
 
 class QueryQueue:
     """Micro-batching frontend over a :class:`~knn_tpu.serving.engine.
@@ -64,12 +67,20 @@ class QueryQueue:
         self.max_wait_s = max_wait_ms / 1e3
         self.max_rows = int(max_rows or engine.buckets[-1])
         self._cond = threading.Condition()
-        #: (queries, future, arrival time) — arrival rides along so the
-        #: max-wait deadline is per request, not per batch window
-        self._pending: List[Tuple[np.ndarray, Future, float]] = []
+        #: (queries, future, arrival time, trace id) — arrival rides
+        #: along so the max-wait deadline is per request, not per batch
+        #: window; the trace id keeps each request's telemetry its own
+        #: even after coalescing (one trace_id per REQUEST, never per
+        #: batch — knn_tpu.obs.trace)
+        self._pending: List[Tuple[np.ndarray, Future, float, object]] = []
         self._pending_rows = 0
         self._closed = False
-        self._stats = {"requests": 0, "dispatches": 0, "coalesced_rows": 0}
+        self._stats = {"requests": 0, "dispatches": 0, "coalesced_rows": 0,
+                       "errors": 0}
+        #: queue-depth gauges: scrape-time truth about the backlog the
+        #: max-wait deadline is currently holding
+        self._g_depth_req = obs.gauge(mn.QUEUE_DEPTH_REQUESTS)
+        self._g_depth_rows = obs.gauge(mn.QUEUE_DEPTH_ROWS)
         #: ARRIVAL-to-result latency of queued requests (bounded window):
         #: the engine's own percentiles start at engine dispatch and so
         #: exclude the micro-batching wait — this one is what a caller
@@ -95,13 +106,17 @@ class QueryQueue:
                 f"queries must be [N, {self.engine._dim}], got shape "
                 f"{q.shape}")
         fut: Future = Future()
+        tid = obs.new_trace_id()  # THIS request's id, coalescing-proof
         with self._cond:
             if self._closed:
                 raise RuntimeError("QueryQueue is closed")
-            self._pending.append((q, fut, time.monotonic()))
+            self._pending.append((q, fut, time.monotonic(), tid))
             self._pending_rows += q.shape[0]
             self._stats["requests"] += 1
+            self._g_depth_req.set(len(self._pending))
+            self._g_depth_rows.set(self._pending_rows)
             self._cond.notify_all()
+        obs.counter(mn.QUEUE_REQUESTS).inc()
         return fut
 
     def close(self) -> None:
@@ -146,7 +161,7 @@ class QueryQueue:
         except Exception:  # noqa: BLE001 — cancelled in the race window
             pass
 
-    def _take_batch(self) -> Optional[List[Tuple[np.ndarray, Future, float]]]:
+    def _take_batch(self) -> Optional[List[Tuple[np.ndarray, Future, float, object]]]:
         """Block until a batch is due (rows >= max_rows, deadline hit, or
         closing with work pending); None means closed and drained.
         Entries keep their arrival times so the completer can report
@@ -170,7 +185,7 @@ class QueryQueue:
                     self._cond.wait()
             # whole requests only: a request is never split across
             # micro-batches (oversize batches split inside the engine)
-            batch: List[Tuple[np.ndarray, Future, float]] = []
+            batch: List[Tuple[np.ndarray, Future, float, object]] = []
             rows = 0
             while self._pending and (
                 not batch or rows + self._pending[0][0].shape[0] <= self.max_rows
@@ -178,6 +193,8 @@ class QueryQueue:
                 batch.append(self._pending.pop(0))
                 rows += batch[-1][0].shape[0]
             self._pending_rows -= rows
+            self._g_depth_req.set(len(self._pending))
+            self._g_depth_rows.set(self._pending_rows)
             return batch
 
     def _batcher(self) -> None:
@@ -190,17 +207,33 @@ class QueryQueue:
                 # batch assembly must resolve this batch's futures, never
                 # kill the batcher thread (a dead batcher hangs every
                 # later request and deadlocks close())
-                arrays = [q for q, _, _ in batch]
+                arrays = [q for q, _, _, _ in batch]
                 cat = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
                 offsets = np.cumsum([0] + [a.shape[0] for a in arrays])
+                # every member's queue-wait span closes at dispatch time,
+                # under its OWN trace id — the coalesced engine request
+                # gets a fresh batch-level id, linked below
+                t_disp = time.monotonic()
+                for q, _, t_arr, tid in batch:
+                    obs.record_span("serving.queue_wait", tid,
+                                    t_disp - t_arr, rows=int(q.shape[0]))
+                    obs.histogram(mn.QUEUE_WAIT).observe(t_disp - t_arr)
                 handle = self.engine.submit(cat, op=self.op)
+                obs.emit_event(
+                    "queue.dispatch", op=self.op,
+                    batch_trace_id=handle.trace_id,
+                    member_trace_ids=[tid for _, _, _, tid in batch],
+                    rows=int(offsets[-1]), requests=len(batch))
             except Exception as e:  # noqa: BLE001 — resolve, don't kill the loop
-                for _, fut, _ in batch:
+                self._record_errors(len(batch))
+                for _, fut, _, _ in batch:
                     self._resolve(fut, exc=e)
                 continue
             with self._cond:
                 self._stats["dispatches"] += 1
                 self._stats["coalesced_rows"] += int(offsets[-1])
+            obs.counter(mn.QUEUE_DISPATCHES).inc()
+            obs.counter(mn.QUEUE_COALESCED_ROWS).inc(int(offsets[-1]))
             self._done.put((handle, batch, offsets))
         self._done.put(None)
 
@@ -214,11 +247,12 @@ class QueryQueue:
             try:
                 res = handle.result()
             except Exception as e:  # noqa: BLE001 — per-batch failure isolation
-                for _, fut, _ in batch:
+                self._record_errors(len(batch))
+                for _, fut, _, _ in batch:
                     self._resolve(fut, exc=e)
                 continue
             done_t = time.monotonic()
-            for j, (_, fut, t_arr) in enumerate(batch):
+            for j, (q, fut, t_arr, tid) in enumerate(batch):
                 lo, hi = int(offsets[j]), int(offsets[j + 1])
                 if self.op == "search":
                     d, i = res
@@ -226,3 +260,16 @@ class QueryQueue:
                 else:
                     self._resolve(fut, res[lo:hi])
                 self._lat.append(done_t - t_arr)
+                # arrival-to-result under the request's own trace id —
+                # what a caller tuning max_wait_ms actually experiences
+                obs.histogram(mn.QUEUE_REQUEST_LATENCY).observe(
+                    done_t - t_arr)
+                obs.record_span("serving.queued_request", tid,
+                                done_t - t_arr, op=self.op,
+                                rows=int(q.shape[0]),
+                                batch_trace_id=handle.trace_id)
+
+    def _record_errors(self, n: int) -> None:
+        with self._cond:
+            self._stats["errors"] += n
+        obs.counter(mn.QUEUE_ERRORS).inc(n)

@@ -35,6 +35,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from knn_tpu import obs
+from knn_tpu.obs import names as _mn
 from knn_tpu.tuning.cache import TuneCache, cache_key, default_cache_path
 
 #: the knob names resolve() returns — exactly the kernel-shaping
@@ -80,9 +82,23 @@ def reset_counters() -> None:
             _COUNTERS[key] = 0
 
 
+#: module counter -> registry twin: the dict above stays the in-process
+#: assertion surface (reset_counters() and all), the registry series are
+#: the scrape-able lifetime mirror (never reset by reset_counters)
+_OBS_TWIN = {
+    "resolve_calls": _mn.TUNING_RESOLVES,
+    "cache_hits": _mn.TUNING_CACHE_HITS,
+    "cache_misses": _mn.TUNING_CACHE_MISSES,
+    "tune_searches": _mn.TUNING_SEARCHES,
+    "candidates_timed": _mn.TUNING_CANDIDATES_TIMED,
+    "candidates_gated_out": _mn.TUNING_GATE_FAILURES,
+}
+
+
 def _bump(name: str, by: int = 1) -> None:
     with _counters_lock:
         _COUNTERS[name] += by
+    obs.counter(_OBS_TWIN[name]).inc(by)
 
 
 def _device_kind() -> str:

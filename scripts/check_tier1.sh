@@ -7,14 +7,18 @@
 #
 # --fast: the inner-loop subset — kernel parity (tiled vs streaming vs
 # int8 bitwise contracts) + quantization bound soundness + the autotuner
-# gate — for edit-compile-test cycles on kernel/emitter code (~tens of
-# seconds instead of the full suite).  The full gate remains the only
-# gate that counts; --fast is a developer convenience (docs/PERF.md).
+# gate + the telemetry registry/exporters (docs/OBSERVABILITY.md; the
+# metric-name lint rides along so an undocumented metric fails here, not
+# in review) — for edit-compile-test cycles on kernel/emitter/obs code
+# (~tens of seconds instead of the full suite).  The full gate remains
+# the only gate that counts; --fast is a developer convenience
+# (docs/PERF.md).
 cd "$(dirname "$0")/.." || exit 1
 if [ "${1:-}" = "--fast" ]; then
+  python scripts/lint_metric_names.py || exit 1
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_pallas_knn.py tests/test_pallas_streaming.py \
-    tests/test_quantize.py tests/test_tuning.py \
+    tests/test_quantize.py tests/test_tuning.py tests/test_obs.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

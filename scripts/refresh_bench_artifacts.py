@@ -170,4 +170,8 @@ with open(DST, "w") as f:
               f"backend={r.get('backend')} "
               f"gate={r.get('pallas_gate_ok')} recall={r.get('recall_at_k')} "
               f"round={r['measured_round']}"
-              f"{' STALE' if r['stale'] else ''}")
+              # telemetry overhead rides only when the session measured
+              # it (bench.py KNN_BENCH_OBS_OVERHEAD); curated verbatim
+              + (f" obs_overhead={r['obs_overhead_pct']}%"
+                 if "obs_overhead_pct" in r else "")
+              + (" STALE" if r["stale"] else ""))

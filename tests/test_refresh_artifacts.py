@@ -236,3 +236,30 @@ def test_int8_carryover_marked_stale_like_any_line(tmp_path):
     (r,) = out
     assert r["precision"] == "int8"
     assert r["measured_round"] == 8 and r["stale"] is True
+
+
+def test_obs_overhead_survives_curation_when_measured(tmp_path):
+    # a session line that measured telemetry overhead
+    # (KNN_BENCH_OBS_OVERHEAD=1) carries obs_overhead_pct; curation must
+    # preserve it verbatim alongside the provenance trio — and a line
+    # WITHOUT the measurement must not grow one
+    with_obs = dict(_line(120.0, gate=True, cfg="knn_qps_obs"),
+                    obs_overhead_pct=0.42)
+    bare = _line(80.0, gate=True, cfg="knn_qps_bare")
+    rows = _run(tmp_path, 9, [with_obs, bare])
+    by_cfg = {r["metric"]: r for r in rows}
+    assert by_cfg["knn_qps_obs"]["obs_overhead_pct"] == 0.42
+    assert "obs_overhead_pct" not in by_cfg["knn_qps_bare"]
+    for r in rows:  # the provenance/stale guard covers obs lines too
+        assert r["measured_round"] == 9 and r["stale"] is False
+        assert "measured_at_commit" in r
+
+
+def test_obs_overhead_carryover_marked_stale(tmp_path):
+    # an obs-measured line republished from an earlier round keeps the
+    # measurement but must say STALE on its face like any other field
+    seed = dict(_line(120.0, gate=True), obs_overhead_pct=0.9,
+                measured_round=7, measured_at_commit="abc1234")
+    (r,) = _run(tmp_path, 9, [], seed_lines=[seed])
+    assert r["obs_overhead_pct"] == 0.9
+    assert r["measured_round"] == 7 and r["stale"] is True
