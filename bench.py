@@ -180,14 +180,26 @@ except Exception as _e:  # bad env: the one-JSON-line contract still holds
 
 #: bf16 MXU peak FLOP/s by device kind (public spec sheets); MFU is an
 #: *estimate* — the denominator assumes bf16 peak even for f32 runs.
+#: Covers every announced TPU generation so the perf sentinel's MFU
+#: baselines stay keyed on any hardware the relay hands us; an unknown
+#: kind yields mfu=null WITH an explicit mfu_reason (below), never a
+#: silently-wrong default.
 _PEAK_BY_KIND = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
     "TPU v4": 275e12,
+    "TPU v4i": 138e12,
     "TPU v5 lite": 197e12,
     "TPU v5e": 197e12,
     "TPU v5": 459e12,
     "TPU v5p": 459e12,
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
+    "TPU v6": 918e12,
+    "TPU v6p": 1847e12,
+    # Ironwood: 4614 TFLOP/s fp8 per chip; bf16 assumed half
+    "TPU v7": 2307e12,
+    "TPU v7x": 2307e12,
 }
 
 
@@ -564,11 +576,23 @@ def main() -> None:
                 }
         return None
     # peak FLOPs for MFU: env override > known device kind > None (a v5e
-    # default on an unknown/CPU backend would yield a meaningless MFU)
+    # default on an unknown/CPU backend would yield a meaningless MFU).
+    # When peak is unknowable, mfu_reason says WHY the line's mfu fields
+    # are null — "cpu backend" (no MXU peak to relate to) vs "unknown
+    # device kind" (extend the table / set KNN_BENCH_PEAK_FLOPS) — so
+    # sentinel baselines can key on MFU exactly where it exists
+    mfu_reason = None
     if "KNN_BENCH_PEAK_FLOPS" in os.environ:
         peak = float(os.environ["KNN_BENCH_PEAK_FLOPS"])
     else:
         peak = _PEAK_BY_KIND.get(getattr(dev, "device_kind", ""))
+        if peak is None:
+            mfu_reason = (
+                "cpu backend: no MXU peak to relate measured FLOPs to"
+                if backend == "cpu" else
+                f"unknown device kind "
+                f"{getattr(dev, 'device_kind', str(dev))!r}: not in "
+                f"_PEAK_BY_KIND and KNN_BENCH_PEAK_FLOPS unset")
 
     from knn_tpu.ops.refine import refine_exact
     from knn_tpu.parallel.mesh import make_mesh
@@ -1179,7 +1203,7 @@ def main() -> None:
             quant_prov["quant_scales_dtype"] = "float32"
         except Exception as e:  # noqa: BLE001 — provenance must not kill the line
             quant_prov["quant_bound_error"] = f"{type(e).__name__}: {e}"
-    _emit({
+    line = {
         "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
         "value": qps,
         "unit": "queries/s",
@@ -1206,6 +1230,9 @@ def main() -> None:
         "runs": RUNS,
         "qps_std": results[best]["qps_std"],
         "mfu": results[best]["mfu"],
+        # explicit null-MFU provenance (unknown device kind vs cpu
+        # backend) so baseline curation can key on MFU where it exists
+        **({"mfu_reason": mfu_reason} if mfu_reason else {}),
         "peak_flops_assumed": peak,
         "selectors": results,
         "cpu_baseline_qps": cpu_qps_r,
@@ -1236,7 +1263,21 @@ def main() -> None:
         "tuning": TUNE_INFO,
         "approx_knobs": {"recall_target": APPROX_RT,
                          "margin": APPROX_MARGIN},
-    })
+    }
+    # perf-regression sentinel verdict (knn_tpu.obs.sentinel): this
+    # line judged against the robust baseline of its own history —
+    # advisory on the line itself (check_tier1 --strict is the gate);
+    # jax-free and failure-proof, it can never break the one-JSON-line
+    # contract
+    try:
+        from knn_tpu.obs import sentinel as _sentinel
+
+        line["sentinel"] = _sentinel.verdict_for_line(
+            line, repo_dir=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:  # noqa: BLE001 — verdict must not kill the line
+        line["sentinel"] = {"verdict": "error",
+                            "error": f"{type(e).__name__}: {e}"}
+    _emit(line)
 
 
 if __name__ == "__main__":

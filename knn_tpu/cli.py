@@ -30,6 +30,15 @@ endpoint) or an atomic JSON snapshot file (knn_tpu.obs exporters) and
 prints it as Prometheus text or JSON — the scrape/debug companion of
 the job flags ``--metrics-port`` / ``--obs-log``
 (docs/OBSERVABILITY.md).
+
+    python -m knn_tpu.cli doctor --port 9100
+    python -m knn_tpu.cli doctor --snapshot /path/run_metrics.json
+
+renders the health/self-diagnosis report (readiness, device inventory,
+engine warmup + queue worker state, SLO breaches, recent alerts) from a
+RUNNING process's ``/statusz`` endpoint or offline from an atomic
+snapshot — the same report either way, jax-free by construction.
+Exit code: 0 healthy, 2 not ready, 1 unreadable source.
 """
 
 from __future__ import annotations
@@ -266,6 +275,62 @@ def run_metrics(args: argparse.Namespace) -> int:
     return 0
 
 
+def build_doctor_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu doctor",
+        description="Render the health/self-diagnosis report "
+        "(knn_tpu.obs.health) of a running process (/statusz) or an "
+        "atomic JSON snapshot, offline and jax-free.  Exit 0 healthy, "
+        "2 not ready, 1 unreadable source.",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--port", type=int, default=None,
+                     help="fetch /statusz from http://HOST:PORT (a "
+                     "process started with --metrics-port)")
+    src.add_argument("--snapshot", default=None, metavar="PATH",
+                     help="read an atomic JSON snapshot file "
+                     "(--metrics-snapshot / obs.write_json_snapshot)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="endpoint host for --port (default localhost)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw report JSON instead of the "
+                   "human-readable rendering")
+    return p
+
+
+def run_doctor(args: argparse.Namespace) -> int:
+    """The `doctor` subcommand — jax-free (knn_tpu.obs imports no JAX):
+    diagnosing a box must not pay a backend init."""
+    import json
+    import urllib.request
+
+    from knn_tpu.obs import health
+
+    if args.port is not None:
+        url = f"http://{args.host}:{args.port}/statusz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                report = json.loads(r.read().decode())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"statusz endpoint {url} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        try:
+            with open(args.snapshot) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read snapshot {args.snapshot}: {e}",
+                  file=sys.stderr)
+            return 1
+        report = health.report_from_snapshot(payload)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    else:
+        sys.stdout.write(health.render_text(report))
+    return 0 if report.get("readiness", {}).get("ready") else 2
+
+
 def args_to_config(args: argparse.Namespace) -> JobConfig:
     return JobConfig(
         train_file=args.train,
@@ -309,6 +374,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_tune(targs)
     if argv[:1] == ["metrics"]:
         return run_metrics(build_metrics_parser().parse_args(argv[1:]))
+    if argv[:1] == ["doctor"]:
+        return run_doctor(build_doctor_parser().parse_args(argv[1:]))
     args = build_parser().parse_args(argv)
     if args.cpu_devices:
         # Must precede backend initialization; env vars are too late when a

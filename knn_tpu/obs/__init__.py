@@ -27,7 +27,7 @@ Metric catalog, span lifecycle, and overhead numbers:
 ``docs/OBSERVABILITY.md``.
 """
 
-from knn_tpu.obs import names  # noqa: F401  (the catalog is public API)
+from knn_tpu.obs import health, names, sentinel, slo  # noqa: F401
 from knn_tpu.obs.export import (  # noqa: F401
     compact_snapshot,
     prometheus_text,
@@ -35,6 +35,14 @@ from knn_tpu.obs.export import (  # noqa: F401
     write_json_snapshot,
 )
 from knn_tpu.obs.jax_hooks import install_compile_hook  # noqa: F401
+from knn_tpu.obs.slo import (  # noqa: F401
+    SLOEngine,
+    Objective,
+    get_slo_engine,
+    load_objectives,
+    reset_slo_engine,
+    slo_report,
+)
 from knn_tpu.obs.registry import (  # noqa: F401
     NOOP,
     Counter,
@@ -61,9 +69,11 @@ from knn_tpu.obs.trace import (  # noqa: F401
 
 __all__ = [
     "NOOP", "Counter", "EventLog", "Gauge", "Histogram",
-    "MetricsRegistry", "compact_snapshot", "counter", "emit_event",
-    "enabled", "gauge", "get_event_log", "get_registry", "histogram",
-    "install_compile_hook", "names", "new_trace_id", "prometheus_text",
-    "record_span", "reset", "reset_event_log", "snapshot", "span",
-    "start_metrics_server", "write_json_snapshot",
+    "MetricsRegistry", "Objective", "SLOEngine", "compact_snapshot",
+    "counter", "emit_event", "enabled", "gauge", "get_event_log",
+    "get_registry", "get_slo_engine", "health", "histogram",
+    "install_compile_hook", "load_objectives", "names", "new_trace_id",
+    "prometheus_text", "record_span", "reset", "reset_event_log",
+    "reset_slo_engine", "sentinel", "slo", "slo_report", "snapshot",
+    "span", "start_metrics_server", "write_json_snapshot",
 ]

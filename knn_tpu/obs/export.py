@@ -93,12 +93,18 @@ def compact_snapshot(snapshot: Optional[dict] = None) -> dict:
 
 def write_json_snapshot(path: str, snapshot: Optional[dict] = None) -> dict:
     """Atomic JSON snapshot (tmp + rename): a scraper of the file can
-    never observe a torn write.  Returns the written payload."""
+    never observe a torn write.  Returns the written payload.  Embeds
+    the health/self-diagnosis report, so ``knn_tpu.cli doctor
+    --snapshot`` renders offline exactly what ``/statusz`` served
+    live."""
+    from knn_tpu.obs import health
+
     payload = {
         "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "pid": os.getpid(),
         "enabled": registry.enabled(),
         "metrics": registry.snapshot() if snapshot is None else snapshot,
+        "health": health.report(),
     }
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
@@ -114,15 +120,21 @@ def write_json_snapshot(path: str, snapshot: Optional[dict] = None) -> dict:
 
 
 def start_metrics_server(port: int, host: str = "127.0.0.1"):
-    """Serve ``/metrics`` (Prometheus text) + ``/metrics.json`` (the
-    full snapshot) from a daemon thread; returns the server (``.shutdown()``
-    to stop; ``.server_address[1]`` for the bound port — pass port 0 to
-    let the OS pick one)."""
+    """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (the full
+    snapshot), ``/healthz`` (liveness/readiness probe: 200 only once
+    warmup completed and worker threads are live — knn_tpu.obs.health),
+    and ``/statusz`` (the full self-diagnosis report) from a daemon
+    thread; returns the server (``.shutdown()`` to stop;
+    ``.server_address[1]`` for the bound port — pass port 0 to let the
+    OS pick one)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - stdlib handler contract
+            from knn_tpu.obs import health
+
             path = self.path.split("?", 1)[0]
+            status = 200
             if path in ("/metrics", "/"):
                 body = prometheus_text().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -132,11 +144,20 @@ def start_metrics_server(port: int, host: str = "127.0.0.1"):
                      "metrics": registry.snapshot()},
                     indent=1, sort_keys=True).encode()
                 ctype = "application/json"
+            elif path == "/healthz":
+                probe = health.probe()
+                status = 200 if probe["ready"] else 503
+                body = json.dumps(probe, sort_keys=True).encode()
+                ctype = "application/json"
+            elif path == "/statusz":
+                body = json.dumps(health.report(), indent=1,
+                                  sort_keys=True, default=str).encode()
+                ctype = "application/json"
             else:
                 self.send_response(404)
                 self.end_headers()
                 return
-            self.send_response(200)
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()

@@ -1,0 +1,317 @@
+"""Live health introspection: liveness/readiness probes and the
+self-diagnosis report behind ``/healthz``, ``/statusz``, and the
+jax-free ``doctor`` CLI subcommand.
+
+Serving components REGISTER here (weakly — a collected engine drops out
+of the report instead of pinning itself alive): ``ServingEngine``
+registers at construction and marks ops warmed in :meth:`warmup`;
+``QueryQueue`` registers its worker threads.  The probes then answer
+the two questions a load balancer asks:
+
+- **live** (``/healthz`` exists at all): the process is up and the obs
+  subsystem can answer — always true once this module is importable.
+- **ready** (``/healthz`` returns 200): at least one registered engine
+  has COMPLETED ``warmup()`` (no live request will pay an inline XLA
+  compile) and every open queue's batcher/completer threads are alive
+  (a dead worker thread hangs every later request — the one failure
+  readiness exists to catch before traffic does).
+
+``/statusz`` (and ``doctor``) render :func:`report` — readiness plus
+self-diagnosis: device inventory (only when JAX is ALREADY initialized
+in the process; a status probe must never trigger a backend init),
+per-engine warmup/bucket/compile state, queue depth vs capacity and
+worker liveness, tune-cache status, active SLO breaches, and the last
+N alert events from the trace ring.  :func:`write
+<knn_tpu.obs.export.write_json_snapshot>` embeds the same report in the
+atomic snapshot, so ``doctor --snapshot`` renders the identical
+structure offline.
+
+Disabled mode (``KNN_TPU_OBS=0``): registration is skipped (no obs
+objects ride the serving hot path) and the report says so — the health
+surface is part of the telemetry opt-in, exactly like the exporters.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import weakref
+from typing import List, Optional
+
+from knn_tpu.obs import names, registry, slo, trace
+
+#: alert events included in the report (newest last)
+REPORT_ALERTS = 20
+
+_lock = threading.Lock()
+_engines: List[weakref.ref] = []
+_queues: List[weakref.ref] = []
+
+
+def register_engine(engine) -> None:
+    """Called by ServingEngine.__init__ (no-op when obs is disabled)."""
+    if not registry.enabled():
+        return
+    with _lock:
+        _engines[:] = [r for r in _engines if r() is not None]
+        if not any(r() is engine for r in _engines):
+            _engines.append(weakref.ref(engine))
+
+
+def register_queue(queue) -> None:
+    """Called by QueryQueue.__init__ (no-op when obs is disabled)."""
+    if not registry.enabled():
+        return
+    with _lock:
+        _queues[:] = [r for r in _queues if r() is not None]
+        if not any(r() is queue for r in _queues):
+            _queues.append(weakref.ref(queue))
+
+
+def reset() -> None:
+    """Drop every registration (test isolation)."""
+    with _lock:
+        _engines.clear()
+        _queues.clear()
+
+
+def _live_components():
+    with _lock:
+        engines = [e for e in (r() for r in _engines) if e is not None]
+        queues = [q for q in (r() for r in _queues) if q is not None]
+    return engines, queues
+
+
+def probe() -> dict:
+    """The /healthz payload: ``ready`` is the 200-vs-503 verdict, the
+    reasons say why not."""
+    engines, queues = _live_components()
+    reasons = []
+    if not registry.enabled():
+        reasons.append("telemetry disabled (KNN_TPU_OBS=0): health "
+                       "introspection is part of the obs opt-in")
+    if not engines:
+        reasons.append("no ServingEngine registered")
+    warmed = [e for e in engines if getattr(e, "warmed_ops", ())]
+    if engines and not warmed:
+        reasons.append("no registered engine has completed warmup()")
+    for q in queues:
+        if getattr(q, "_closed", False):
+            continue  # a deliberately closed queue is not a failure
+        for tname in ("_batcher_t", "_completer_t"):
+            t = getattr(q, tname, None)
+            if t is not None and not t.is_alive():
+                reasons.append(
+                    f"queue worker thread {tname.strip('_')} is dead")
+    ready = not reasons
+    if registry.enabled():
+        registry.gauge(names.HEALTH_READY).set(1.0 if ready else 0.0)
+    return {"live": True, "ready": ready, "reasons": reasons}
+
+
+def _device_inventory() -> dict:
+    """Device list WITHOUT triggering a backend init: only consult JAX
+    when something else in the process already imported it."""
+    if "jax" not in sys.modules:
+        return {"available": False,
+                "reason": "jax not imported in this process"}
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return {"available": False,
+                    "reason": "jax imported but no backend initialized"}
+        devs = jax.devices()
+        return {
+            "available": True,
+            "backend": jax.default_backend(),
+            "count": len(devs),
+            "kinds": sorted({getattr(d, "device_kind", str(d))
+                             for d in devs}),
+        }
+    except Exception as e:  # noqa: BLE001 - introspection must not raise
+        return {"available": False,
+                "reason": f"{type(e).__name__}: {e}"}
+
+
+def _engine_status(e) -> dict:
+    try:
+        # the report's top level already ran ONE SLO evaluation; each
+        # engine contributes raw stats only (no per-engine re-pass —
+        # it would inflate knn_tpu_slo_evaluations_total per scrape)
+        st = e.stats(include_slo=False)
+    except TypeError:  # engine-like object without the kwarg
+        st = e.stats()
+    except Exception as ex:  # noqa: BLE001
+        return {"error": f"{type(ex).__name__}: {ex}"}
+    return {
+        "warmed_ops": sorted(getattr(e, "warmed_ops", ())),
+        "buckets": st.get("buckets"),
+        "executables": st.get("executables"),
+        "compile_count": st.get("compile_count"),
+        "requests_total": st.get("requests_total"),
+        "queries_total": st.get("queries_total"),
+        "errors_total": st.get("errors_total"),
+        "latency_ms": st.get("latency_ms"),
+    }
+
+
+def _queue_status(q) -> dict:
+    # racy-but-safe reads of the queue's own backlog (list len / int):
+    # a status probe must never contend for the dispatch condvar
+    depth_req = len(getattr(q, "_pending", ()))
+    depth_rows = int(getattr(q, "_pending_rows", 0))
+    return {
+        "op": getattr(q, "op", None),
+        "closed": bool(getattr(q, "_closed", False)),
+        "max_wait_ms": round(getattr(q, "max_wait_s", 0.0) * 1e3, 3),
+        "capacity_rows": getattr(q, "max_rows", None),
+        "depth_requests": depth_req,
+        "depth_rows": depth_rows,
+        "rows_utilization": (round(depth_rows / q.max_rows, 4)
+                             if getattr(q, "max_rows", 0) else None),
+        "batcher_alive": q._batcher_t.is_alive(),
+        "completer_alive": q._completer_t.is_alive(),
+    }
+
+
+def _tune_cache_status() -> dict:
+    try:
+        from knn_tpu.tuning.cache import default_cache_path
+
+        path = default_cache_path()
+        out = {"path": path, "exists": os.path.exists(path)}
+        if out["exists"]:
+            import json
+
+            with open(path) as f:
+                data = json.load(f)
+            out["entries"] = len(data.get("entries", {}))
+            out["version"] = data.get("version")
+        return out
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def report() -> dict:
+    """The full /statusz payload (see module docstring).  Everything in
+    it is JSON-serializable; ``doctor`` renders the same structure."""
+    pr = probe()
+    slo_section = slo.slo_report()
+    alerts = [e for e in trace.get_event_log().recent()
+              if e.get("name") == "slo.alert"][-REPORT_ALERTS:]
+    engines, queues = _live_components()
+    return {
+        "generated_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "pid": os.getpid(),
+        "obs_enabled": registry.enabled(),
+        "liveness": {"live": pr["live"]},
+        "readiness": {"ready": pr["ready"], "reasons": pr["reasons"]},
+        "devices": _device_inventory(),
+        "engines": [_engine_status(e) for e in engines],
+        "queues": [_queue_status(q) for q in queues],
+        "tune_cache": _tune_cache_status(),
+        "slo": slo_section,
+        "active_breaches": (slo_section.get("breached", [])
+                            if slo_section else []),
+        "alerts": alerts,
+    }
+
+
+def report_from_snapshot(payload: dict) -> dict:
+    """Recover a report from an atomic JSON snapshot (export.
+    write_json_snapshot embeds ``health``; pre-health snapshots degrade
+    to what the metrics alone can say)."""
+    if "health" in payload:
+        return payload["health"]
+    metrics = payload.get("metrics", {})
+    ready_series = metrics.get(names.HEALTH_READY, {}).get("series", [])
+    ready = bool(ready_series and ready_series[0]["value"] == 1.0)
+    return {
+        "generated_at": payload.get("written_at"),
+        "pid": payload.get("pid"),
+        "obs_enabled": payload.get("enabled"),
+        "liveness": {"live": None},
+        "readiness": {
+            "ready": ready if ready_series else None,
+            "reasons": ["snapshot predates the health section — "
+                        "readiness derived from the "
+                        + names.HEALTH_READY + " gauge only"],
+        },
+        "devices": {"available": False,
+                    "reason": "not recorded in this snapshot"},
+        "engines": [], "queues": [],
+        "tune_cache": {}, "slo": {}, "active_breaches": [], "alerts": [],
+    }
+
+
+def render_text(rep: dict) -> str:
+    """Human-readable rendering of a report dict — shared by ``doctor``
+    against both a live /statusz fetch and an offline snapshot, so the
+    two sources print identically for identical state."""
+    lines = []
+    ready = rep.get("readiness", {}).get("ready")
+    verdict = {True: "READY", False: "NOT READY", None: "UNKNOWN"}[ready]
+    lines.append(f"health: {verdict}   (pid {rep.get('pid')}, "
+                 f"generated {rep.get('generated_at')}, "
+                 f"obs_enabled={rep.get('obs_enabled')})")
+    for r in rep.get("readiness", {}).get("reasons", []):
+        lines.append(f"  reason: {r}")
+    dev = rep.get("devices", {})
+    if dev.get("available"):
+        lines.append(f"devices: {dev['count']}x {','.join(dev['kinds'])} "
+                     f"({dev['backend']})")
+    else:
+        lines.append(f"devices: unavailable ({dev.get('reason')})")
+    for i, e in enumerate(rep.get("engines", [])):
+        lat = e.get("latency_ms") or {}
+        lines.append(
+            f"engine[{i}]: warmed={e.get('warmed_ops')} "
+            f"buckets={e.get('buckets')} "
+            f"executables={e.get('executables')} "
+            f"compiles={e.get('compile_count')} "
+            f"requests={e.get('requests_total')} "
+            f"errors={e.get('errors_total')} "
+            f"p99_ms={lat.get('p99')} "
+            f"(window {lat.get('window_samples')} samples / "
+            f"{lat.get('window_span_s')}s)")
+    for i, q in enumerate(rep.get("queues", [])):
+        lines.append(
+            f"queue[{i}]: op={q.get('op')} closed={q.get('closed')} "
+            f"depth={q.get('depth_requests')}req/"
+            f"{q.get('depth_rows')}rows of {q.get('capacity_rows')} "
+            f"(util {q.get('rows_utilization')}) "
+            f"batcher={'up' if q.get('batcher_alive') else 'DOWN'} "
+            f"completer={'up' if q.get('completer_alive') else 'DOWN'}")
+    tc = rep.get("tune_cache", {})
+    if tc:
+        lines.append(f"tune_cache: {tc.get('path')} "
+                     f"exists={tc.get('exists')} "
+                     f"entries={tc.get('entries')}")
+    breaches = rep.get("active_breaches", [])
+    lines.append(f"slo breaches: {', '.join(breaches) if breaches else 'none'}")
+    for o_name, o in (rep.get("slo", {}).get("objectives", {}) or {}).items():
+        state = "BREACHED" if o.get("breached") else "ok"
+        if o.get("kind") == "quantile":
+            lines.append(
+                f"  slo {o_name}: {state} {o.get('quantile')}="
+                f"{o.get('value_s')}s (threshold {o.get('threshold_s')}s, "
+                f"window {o.get('window_samples')} samples / "
+                f"{o.get('window_span_s')}s)")
+        else:
+            burns = {w: d.get("burn_rate")
+                     for w, d in (o.get("windows") or {}).items()}
+            lines.append(
+                f"  slo {o_name}: {state} burn={burns} "
+                f"(target {o.get('target')})")
+    alerts = rep.get("alerts", [])
+    if alerts:
+        lines.append(f"last {len(alerts)} alert event(s):")
+        for a in alerts:
+            lines.append(f"  [{a.get('ts')}] {a.get('objective')} "
+                         f"{a.get('state')}")
+    return "\n".join(lines) + "\n"

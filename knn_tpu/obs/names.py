@@ -60,6 +60,15 @@ PHASE_SECONDS = "knn_tpu_phase_seconds"
 SPAN_SECONDS = "knn_tpu_span_seconds"
 EVENTS_DROPPED = "knn_tpu_events_dropped_total"
 
+# --- SLO engine (knn_tpu.obs.slo) --------------------------------------
+SLO_BURN_RATE = "knn_tpu_slo_burn_rate"
+SLO_BREACHED = "knn_tpu_slo_breached"
+SLO_BREACH_TRANSITIONS = "knn_tpu_slo_breach_transitions_total"
+SLO_EVALUATIONS = "knn_tpu_slo_evaluations_total"
+
+# --- health introspection (knn_tpu.obs.health) -------------------------
+HEALTH_READY = "knn_tpu_health_ready"
+
 #: name -> (type, label names, help).  Types: "counter" (monotone,
 #: float-valued so second-counters work), "gauge", "histogram" (bounded
 #: sample window + lifetime count/sum; exported as a Prometheus summary).
@@ -166,4 +175,25 @@ CATALOG = {
     EVENTS_DROPPED: (
         "counter", (),
         "Structured events dropped because the JSONL sink raised."),
+    SLO_BURN_RATE: (
+        "gauge", ("objective", "window"),
+        "Error-budget burn rate per SLO objective and evaluation window "
+        "(ratio objectives: window error ratio / budget; quantile "
+        "objectives: window quantile / threshold, window label 'hist')."),
+    SLO_BREACHED: (
+        "gauge", ("objective",),
+        "1 while the objective's multi-window burn-rate policy is "
+        "breached, 0 otherwise (edge transitions emit slo.alert events)."),
+    SLO_BREACH_TRANSITIONS: (
+        "counter", ("objective",),
+        "Healthy-to-breached transitions per objective (each one also "
+        "emits exactly one firing slo.alert event)."),
+    SLO_EVALUATIONS: (
+        "counter", (),
+        "SLO engine evaluation passes (each appends one counter sample "
+        "to the burn-rate window ring)."),
+    HEALTH_READY: (
+        "gauge", (),
+        "1 when the readiness probe passes (warmup complete, worker "
+        "threads live), 0 otherwise; set on every /healthz or report()."),
 }

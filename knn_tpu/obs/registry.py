@@ -30,6 +30,7 @@ from __future__ import annotations
 import os
 import re
 import threading
+import time
 from collections import deque
 from typing import Dict, Optional, Tuple
 
@@ -96,7 +97,8 @@ class Histogram:
     """Lifetime count/sum/min/max + a bounded recent-sample window the
     percentiles are computed over (see module docstring)."""
 
-    __slots__ = ("_lock", "_count", "_sum", "_min", "_max", "_window")
+    __slots__ = ("_lock", "_count", "_sum", "_min", "_max", "_window",
+                 "_wts")
 
     def __init__(self, window: int = DEFAULT_WINDOW):
         self._lock = threading.Lock()
@@ -105,9 +107,15 @@ class Histogram:
         self._min: Optional[float] = None
         self._max: Optional[float] = None
         self._window: deque = deque(maxlen=int(window))
+        #: arrival timestamps parallel to _window, so the summary can
+        #: say WHICH wall span its percentiles cover — a window
+        #: quantile without its span is ambiguous between "the last
+        #: second" and "since boot" (the window-vs-lifetime fix)
+        self._wts: deque = deque(maxlen=int(window))
 
     def observe(self, value: float) -> None:
         v = float(value)
+        t = time.monotonic()
         with self._lock:
             self._count += 1
             self._sum += v
@@ -116,6 +124,7 @@ class Histogram:
             if self._max is None or v > self._max:
                 self._max = v
             self._window.append(v)
+            self._wts.append(t)
 
     def observe_many(self, values) -> None:
         """Bulk observe (one lock acquisition) — the int8 quant-bound
@@ -124,6 +133,7 @@ class Histogram:
         if not vs:
             return
         lo, hi = min(vs), max(vs)
+        t = time.monotonic()
         with self._lock:
             self._count += len(vs)
             self._sum += sum(vs)
@@ -132,16 +142,22 @@ class Histogram:
             if self._max is None or hi > self._max:
                 self._max = hi
             self._window.extend(vs)
+            self._wts.extend([t] * len(vs))
 
     def get(self) -> Dict[str, float]:
         return self.summary()
 
     def summary(self) -> Dict[str, float]:
-        """Lifetime count/sum/min/max + window p50/p95/p99/mean."""
+        """Lifetime count/sum/min/max + window p50/p95/p99/mean.  The
+        window percentiles carry their provenance — ``window`` (sample
+        count) and ``window_span_s`` (wall span from oldest to newest
+        windowed sample) — so every consumer can label which window a
+        quantile came from instead of conflating it with lifetime."""
         with self._lock:
             count, total = self._count, self._sum
             mn, mx = self._min, self._max
             window = list(self._window)
+            wts = list(self._wts)
         out: Dict[str, float] = {"count": count, "sum": total}
         if mn is not None:
             out["min"], out["max"] = mn, mx
@@ -157,6 +173,7 @@ class Histogram:
                 "p99": float(np.percentile(arr, 99)),
                 "mean": float(arr.mean()),
                 "window": int(arr.size),
+                "window_span_s": round(wts[-1] - wts[0], 3) if wts else 0.0,
             })
         return out
 

@@ -1,0 +1,454 @@
+"""Declarative SLOs evaluated with multi-window burn rates over the
+registry — the judgment layer the raw counters/histograms feed.
+
+An **objective** is either
+
+- a ``ratio`` (bad-event counter / total counter, e.g. serving errors
+  per request) with an availability ``target``: the error budget is
+  ``1 - target``, and the **burn rate** over a window is the window's
+  error ratio divided by that budget (burn 1.0 = spending the budget
+  exactly as fast as the SLO allows; burn 6.0 = six times too fast); or
+- a ``quantile`` (a bounded-window histogram percentile, e.g. request
+  p99 latency) against an absolute ``threshold``; its "burn rate" is
+  value/threshold, reported under the pseudo-window ``hist``.
+
+Counters in the registry are CUMULATIVE, so windowed ratios need
+history: each :meth:`SLOEngine.evaluate` appends one timestamped sample
+of every referenced counter to a bounded ring and computes deltas
+against the sample closest to each window's far edge (the actual span
+used is reported next to the requested one — window truth is always
+labeled, never implied; the same contract the latency summaries
+follow).  An objective **breaches** when EVERY configured window is
+CONFIRMABLE (its actual span has reached at least ``MIN_SPAN_FRACTION``
+of its requested span — one second of cold-start history must never
+page the 600 s window) and burns at or above the objective's
+``burn_threshold`` (ratio default 6x budget; quantile default 1x
+threshold) — the classic multi-window guard: the slow window proves
+sustained damage, the fast window proves it is still happening, so a
+long-healed spike cannot page and a fresh spike cannot page off one
+noisy minute.  The ring is thinned to one sample per
+``slow_span / (SAMPLE_RING/2)`` seconds, so fast stats() polling can
+never starve the slow window of stored history; evaluation itself is
+serialized under one lock, so concurrent callers can never double-emit
+a transition alert.
+
+Breach state is EDGE-TRIGGERED: the healthy->breached transition emits
+exactly one ``slo.alert`` event (``state="firing"``) into the trace
+ring / JSONL sink, increments ``knn_tpu_slo_breach_transitions_total``,
+and sets ``knn_tpu_slo_breached{objective}``; recovery emits one
+``state="resolved"`` event and clears the gauge.  Re-evaluating a
+still-breached objective re-reports it but never re-alerts.
+
+Disabled mode (``KNN_TPU_OBS=0``): :func:`get_slo_engine` returns ONE
+shared inert engine whose ``evaluate()`` returns ``{}`` — no samples,
+no gauges, no events, no allocation on any caller's path.
+
+Objectives are configurable via ``KNN_TPU_SLO_CONFIG`` (a JSON file:
+``[{"name": ..., "kind": ..., ...}, ...]`` replacing the defaults);
+:func:`load_objectives` validates every entry against the metric
+catalog, and ``scripts/perf_sentinel.py --lint`` runs that validation
+in CI without timing anything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+from knn_tpu.obs import names, registry, trace
+
+#: env var naming a JSON objectives file (unset = DEFAULT_OBJECTIVES)
+CONFIG_ENV = "KNN_TPU_SLO_CONFIG"
+
+#: (label, span seconds) — the fast window confirms a breach is live,
+#: the slow one that it is sustained
+DEFAULT_WINDOWS: Tuple[Tuple[str, float], ...] = (
+    ("fast", 60.0), ("slow", 600.0))
+
+#: counter-sample ring bound: at one evaluate per scrape (~15 s) this
+#: holds over an hour of history, enough for the slow window
+SAMPLE_RING = 256
+
+#: a window may only CONFIRM a breach once its actual span reaches this
+#: fraction of the requested span — a cold-start engine whose whole
+#: history is one second old must not page the 600 s window off that
+#: second (the exact failure multi-window burn rates exist to prevent)
+MIN_SPAN_FRACTION = 0.5
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declarative SLO.  ``kind="ratio"``: ``num``/``den`` are
+    catalog counter names (all label series summed) and ``target`` is
+    the availability goal (budget = 1 - target).  ``kind="quantile"``:
+    ``hist`` is a catalog histogram name and ``threshold`` the absolute
+    bound (seconds for the latency objectives) on ``quantile``."""
+
+    name: str
+    kind: str  # "ratio" | "quantile"
+    num: Optional[str] = None
+    den: Optional[str] = None
+    target: Optional[float] = None
+    hist: Optional[str] = None
+    quantile: str = "p99"
+    threshold: Optional[float] = None
+    #: breach when every window burns at >= this multiple of budget
+    #: (ratio default 6.0); for quantile objectives, value/threshold at
+    #: >= this multiple (default 1.0 — the threshold IS the line).
+    #: None = the kind's default.
+    burn_threshold: Optional[float] = None
+
+    @property
+    def effective_burn_threshold(self) -> float:
+        if self.burn_threshold is not None:
+            return self.burn_threshold
+        return 6.0 if self.kind == "ratio" else 1.0
+
+    def validate(self) -> None:
+        from knn_tpu.obs.names import CATALOG
+
+        if self.kind == "ratio":
+            for role, metric in (("num", self.num), ("den", self.den)):
+                if metric not in CATALOG:
+                    raise ValueError(
+                        f"SLO {self.name!r}: {role}={metric!r} is not a "
+                        f"catalog metric")
+                if CATALOG[metric][0] != "counter":
+                    raise ValueError(
+                        f"SLO {self.name!r}: {role}={metric!r} must be a "
+                        f"counter, is a {CATALOG[metric][0]}")
+            if not (self.target is not None and 0.0 < self.target < 1.0):
+                raise ValueError(
+                    f"SLO {self.name!r}: ratio target must be in (0, 1), "
+                    f"got {self.target}")
+        elif self.kind == "quantile":
+            if self.hist not in CATALOG:
+                raise ValueError(
+                    f"SLO {self.name!r}: hist={self.hist!r} is not a "
+                    f"catalog metric")
+            if CATALOG[self.hist][0] != "histogram":
+                raise ValueError(
+                    f"SLO {self.name!r}: hist={self.hist!r} must be a "
+                    f"histogram, is a {CATALOG[self.hist][0]}")
+            if self.quantile not in ("p50", "p95", "p99"):
+                raise ValueError(
+                    f"SLO {self.name!r}: quantile must be p50/p95/p99, "
+                    f"got {self.quantile!r}")
+            if not (self.threshold is not None and self.threshold > 0):
+                raise ValueError(
+                    f"SLO {self.name!r}: quantile threshold must be > 0, "
+                    f"got {self.threshold}")
+        else:
+            raise ValueError(
+                f"SLO {self.name!r}: kind must be 'ratio' or 'quantile', "
+                f"got {self.kind!r}")
+        if self.burn_threshold is not None and self.burn_threshold <= 0:
+            raise ValueError(
+                f"SLO {self.name!r}: burn_threshold must be > 0")
+
+
+#: the serving-stack defaults the ISSUE names: availability, tail
+#: latency, queue wait, and the certified path's quality rates
+DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
+    Objective(name="serving_availability", kind="ratio",
+              num=names.SERVING_ERRORS, den=names.SERVING_REQUESTS,
+              target=0.999),
+    Objective(name="serving_request_p99", kind="quantile",
+              hist=names.SERVING_REQUEST_LATENCY, quantile="p99",
+              threshold=1.0),
+    Objective(name="queue_wait_p95", kind="quantile",
+              hist=names.QUEUE_WAIT, quantile="p95",
+              threshold=0.1),
+    Objective(name="certified_fallback_rate", kind="ratio",
+              num=names.CERTIFIED_FALLBACKS, den=names.CERTIFIED_QUERIES,
+              target=0.95),
+    Objective(name="certified_false_alarm_rate", kind="ratio",
+              num=names.CERTIFIED_FALSE_ALARMS, den=names.CERTIFIED_QUERIES,
+              target=0.99),
+)
+
+
+def load_objectives(path: Optional[str] = None) -> Tuple[Objective, ...]:
+    """The configured objectives: ``path`` (or ``KNN_TPU_SLO_CONFIG``)
+    names a JSON list replacing the defaults; every entry is validated
+    against the catalog.  Raises ``ValueError`` on any bad entry — the
+    lint gate (perf_sentinel --lint) runs this so a broken config fails
+    in CI, not at serve time."""
+    path = path or os.environ.get(CONFIG_ENV)
+    if not path:
+        objs = DEFAULT_OBJECTIVES
+    else:
+        with open(path) as f:
+            raw = json.load(f)
+        if not isinstance(raw, list) or not raw:
+            raise ValueError(
+                f"SLO config {path}: expected a non-empty JSON list")
+        objs = tuple(Objective(**entry) for entry in raw)
+    seen = set()
+    for o in objs:
+        if o.name in seen:
+            raise ValueError(f"duplicate SLO objective name {o.name!r}")
+        seen.add(o.name)
+        o.validate()
+    return objs
+
+
+def _summed(snapshot: dict, name: str) -> float:
+    """Sum of every label series of a counter (SLOs judge the whole
+    surface; per-label drill-down is what the raw metric is for)."""
+    m = snapshot.get(name)
+    if not m:
+        return 0.0
+    return float(sum(s["value"] for s in m["series"]))
+
+
+def _hist_summary(snapshot: dict, name: str) -> Optional[dict]:
+    """Merged summary across a histogram's label series (max of the
+    quantiles — the conservative read for a threshold objective —
+    plus combined window metadata)."""
+    m = snapshot.get(name)
+    if not m:
+        return None
+    merged: Optional[dict] = None
+    for s in m["series"]:
+        v = s["value"]
+        if "p50" not in v:
+            continue
+        if merged is None:
+            merged = dict(v)
+        else:
+            for q in ("p50", "p95", "p99"):
+                merged[q] = max(merged[q], v[q])
+            merged["window"] = merged.get("window", 0) + v.get("window", 0)
+            spans = [x for x in (merged.get("window_span_s"),
+                                 v.get("window_span_s")) if x is not None]
+            if spans:
+                merged["window_span_s"] = max(spans)
+    return merged
+
+
+class SLOEngine:
+    """Evaluates the objectives against the live registry; owns the
+    counter-sample ring the burn-rate windows delta against."""
+
+    def __init__(self, objectives: Optional[Sequence[Objective]] = None,
+                 windows: Sequence[Tuple[str, float]] = DEFAULT_WINDOWS,
+                 clock=time.monotonic):
+        self.objectives = tuple(
+            load_objectives() if objectives is None else objectives)
+        self.windows = tuple(windows)
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: (monotonic t, {counter name: summed value})
+        self._samples: deque = deque(maxlen=SAMPLE_RING)
+        #: thin the ring so it always spans the slowest window even
+        #: under fast polling (a 10 Hz stats() dashboard must not cap
+        #: the stored history at ring/10 seconds): keep at most one
+        #: sample per interval, sized so half the ring covers the
+        #: slowest window
+        max_span = max((s for _, s in self.windows), default=600.0)
+        self._min_sample_gap = max_span / (SAMPLE_RING // 2)
+        self._breached: Dict[str, bool] = {}
+
+    # -- window machinery --------------------------------------------------
+    def _ratio_counters(self):
+        out = set()
+        for o in self.objectives:
+            if o.kind == "ratio":
+                out.add(o.num)
+                out.add(o.den)
+        return out
+
+    @staticmethod
+    def _window_base(samples, now: float, span: float):
+        """The sample the window deltas against: the NEWEST one at least
+        ``span`` old (effective span >= requested — a stale-history
+        evaluation dilutes toward lifetime truth instead of inventing a
+        window it has no data for), else the OLDEST available."""
+        base = None
+        for t, vals in samples:
+            if now - t >= span:
+                base = (t, vals)
+            else:
+                break
+        return base if base is not None else (
+            samples[0] if samples else None)
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        """One evaluation pass: returns the ``slo`` report section and,
+        on breach-state transitions, emits the alert events / bumps the
+        transition counter.  ``now`` is injectable for deterministic
+        tests; production callers leave it None."""
+        if not registry.enabled():
+            return {}
+        now = self._clock() if now is None else float(now)
+        snap = registry.snapshot()
+        registry.counter(names.SLO_EVALUATIONS).inc()
+        current = {name: _summed(snap, name)
+                   for name in self._ratio_counters()}
+        report: dict = {"objectives": {}, "breached": [],
+                        "evaluated_at": round(time.time(), 3)}
+        # ONE lock over read-evaluate-transition-append: concurrent
+        # evaluations (serving threads' stats(), the HTTP handlers)
+        # must serialize here, or two of them could both observe a
+        # healthy->breached edge and double-emit the alert the
+        # exactly-once contract forbids
+        with self._lock:
+            samples = list(self._samples)
+            for o in self.objectives:
+                if o.kind == "ratio":
+                    entry = self._eval_ratio(o, samples, current, now)
+                else:
+                    entry = self._eval_quantile(o, snap)
+                report["objectives"][o.name] = entry
+                self._transition(o, entry)
+                if entry["breached"]:
+                    report["breached"].append(o.name)
+            # thinned append: bound the ring's TIME span from below so
+            # fast polling cannot starve the slow window of history
+            if (not self._samples
+                    or now - self._samples[-1][0] >= self._min_sample_gap):
+                self._samples.append((now, current))
+        return report
+
+    def _eval_ratio(self, o: Objective, samples, current, now) -> dict:
+        budget = 1.0 - o.target
+        threshold = o.effective_burn_threshold
+        windows = {}
+        confirms = []
+        for label, span in self.windows:
+            base = self._window_base(samples, now, span)
+            if base is None:
+                windows[label] = {"requested_s": span, "span_s": None,
+                                  "ratio": None, "burn_rate": None,
+                                  "confirmable": False}
+                continue
+            t0, vals0 = base
+            actual = now - t0
+            dn = current[o.num] - vals0.get(o.num, 0.0)
+            dd = current[o.den] - vals0.get(o.den, 0.0)
+            ratio = (dn / dd) if dd > 0 else 0.0
+            burn = ratio / budget if budget > 0 else 0.0
+            # a window with too little history may not CONFIRM a
+            # breach: one second of data must not page the 600 s
+            # window (spans LONGER than requested are fine — they
+            # dilute toward lifetime truth, the conservative side)
+            confirmable = actual >= MIN_SPAN_FRACTION * span
+            if confirmable:
+                confirms.append(burn >= threshold)
+            windows[label] = {
+                "requested_s": span,
+                "span_s": round(actual, 3),
+                "confirmable": confirmable,
+                "num_delta": dn, "den_delta": dd,
+                "ratio": round(ratio, 6), "burn_rate": round(burn, 3),
+            }
+            registry.gauge(names.SLO_BURN_RATE, objective=o.name,
+                           window=label).set(burn)
+        breached = (len(confirms) == len(self.windows)
+                    and all(confirms))
+        return {"kind": "ratio", "target": o.target, "budget": budget,
+                "burn_threshold": threshold,
+                "num": o.num, "den": o.den,
+                "windows": windows, "breached": breached}
+
+    def _eval_quantile(self, o: Objective, snap) -> dict:
+        s = _hist_summary(snap, o.hist)
+        value = None if s is None else s.get(o.quantile)
+        burn = None if value is None else value / o.threshold
+        threshold = o.effective_burn_threshold  # quantile default 1.0
+        if burn is not None:
+            registry.gauge(names.SLO_BURN_RATE, objective=o.name,
+                           window="hist").set(burn)
+        # which window the quantile came from rides the entry — the
+        # number is meaningless without its sample count and wall span
+        return {"kind": "quantile", "hist": o.hist,
+                "quantile": o.quantile, "threshold_s": o.threshold,
+                "burn_threshold": threshold,
+                "value_s": None if value is None else round(value, 6),
+                "burn_rate": None if burn is None else round(burn, 3),
+                "window_samples": None if s is None else s.get("window"),
+                "window_span_s": None if s is None else s.get(
+                    "window_span_s"),
+                "breached": bool(burn is not None
+                                 and burn >= threshold)}
+
+    def _transition(self, o: Objective, entry: dict) -> None:
+        was = self._breached.get(o.name, False)
+        is_now = entry["breached"]
+        registry.gauge(names.SLO_BREACHED, objective=o.name).set(
+            1.0 if is_now else 0.0)
+        if is_now == was:
+            return
+        self._breached[o.name] = is_now
+        detail = {k: entry[k] for k in ("windows", "value_s", "burn_rate",
+                                        "window_samples", "window_span_s")
+                  if k in entry}
+        if is_now:
+            registry.counter(names.SLO_BREACH_TRANSITIONS,
+                             objective=o.name).inc()
+            trace.emit_event("slo.alert", objective=o.name,
+                             state="firing", kind=o.kind, **detail)
+        else:
+            trace.emit_event("slo.alert", objective=o.name,
+                             state="resolved", kind=o.kind, **detail)
+
+    def active_breaches(self):
+        with self._lock:
+            return sorted(n for n, b in self._breached.items() if b)
+
+
+class _NoopSLOEngine:
+    """Disabled-mode stand-in: ONE shared inert engine (the registry's
+    no-op discipline) — evaluate allocates nothing and returns {}."""
+
+    __slots__ = ()
+    objectives: Tuple[Objective, ...] = ()
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        return {}
+
+    def active_breaches(self):
+        return []
+
+
+NOOP_SLO = _NoopSLOEngine()
+
+_state_lock = threading.Lock()
+_engine = None
+
+
+def get_slo_engine() -> SLOEngine:
+    """The process-wide SLO engine (objectives from the env config or
+    the defaults); the shared no-op when the subsystem is disabled."""
+    global _engine
+    if not registry.enabled():
+        return NOOP_SLO
+    eng = _engine
+    if eng is None or isinstance(eng, _NoopSLOEngine):
+        with _state_lock:
+            if _engine is None or isinstance(_engine, _NoopSLOEngine):
+                _engine = SLOEngine()
+            eng = _engine
+    return eng
+
+
+def reset_slo_engine(objectives: Optional[Sequence[Objective]] = None):
+    """Swap in a fresh engine (clears samples + breach state); tests."""
+    global _engine
+    with _state_lock:
+        _engine = (SLOEngine(objectives)
+                   if registry.enabled() else NOOP_SLO)
+        return _engine
+
+
+def slo_report(now: Optional[float] = None) -> dict:
+    """Evaluate-and-report: the ``slo`` section ServingEngine.stats()
+    and JobResult.metrics() embed ({} when disabled)."""
+    return get_slo_engine().evaluate(now=now)

@@ -36,6 +36,14 @@ from knn_tpu.obs import names, registry
 #: env var naming the JSONL sink (unset = in-memory ring only)
 LOG_ENV = "KNN_TPU_OBS_LOG"
 
+#: env var capping the JSONL sink's size before rotation (bytes)
+LOG_MAX_BYTES_ENV = "KNN_TPU_OBS_LOG_MAX_BYTES"
+
+#: default rotation cap: a long-running serving process must not grow
+#: the event log unboundedly; at ~200 bytes/event this holds ~300k
+#: events live plus one rotated generation
+DEFAULT_LOG_MAX_BYTES = 64 * 1024 * 1024
+
 #: in-memory event ring size — enough to hold a serving trace's worth of
 #: spans for tests/debugging without unbounded growth
 RING_SIZE = 8192
@@ -50,15 +58,32 @@ def new_trace_id() -> Optional[str]:
 
 
 class EventLog:
-    """Bounded ring + optional JSONL file sink.  ``emit`` is thread-safe
-    and never raises into the instrumented path: a failing sink counts
-    ``knn_tpu_events_dropped_total`` instead."""
+    """Bounded ring + optional size-capped JSONL file sink.  ``emit`` is
+    thread-safe and never raises into the instrumented path: a failing
+    sink counts ``knn_tpu_events_dropped_total`` instead.
 
-    def __init__(self, path: Optional[str] = None, ring: int = RING_SIZE):
+    The file sink ROTATES: when appending the next line would push the
+    file past ``max_bytes`` (``KNN_TPU_OBS_LOG_MAX_BYTES``), the current
+    file is atomically renamed to ``<path>.1`` (replacing any previous
+    generation) and a fresh file begins — so a long-running serving
+    process holds at most two generations on disk, and because rotation
+    happens on LINE boundaries (never mid-write), both sides of the cut
+    are always valid JSONL."""
+
+    def __init__(self, path: Optional[str] = None, ring: int = RING_SIZE,
+                 max_bytes: Optional[int] = None):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=int(ring))
         self._path = path
         self._fh = None
+        self._size = 0  # bytes in the current generation (set on open)
+        if max_bytes is None:
+            try:
+                max_bytes = int(os.environ.get(
+                    LOG_MAX_BYTES_ENV, DEFAULT_LOG_MAX_BYTES))
+            except ValueError:
+                max_bytes = DEFAULT_LOG_MAX_BYTES
+        self._max_bytes = max(1, int(max_bytes))
 
     @property
     def path(self) -> Optional[str]:
@@ -75,8 +100,23 @@ class EventLog:
                 try:
                     if self._fh is None:
                         self._fh = open(self._path, "a")
+                        self._fh.seek(0, 2)
+                        self._size = self._fh.tell()
+                    # json.dumps default is ASCII-escaped, so character
+                    # count == byte count for the size accounting
+                    if (self._size > 0
+                            and self._size + len(line) > self._max_bytes):
+                        # rotate BETWEEN lines: close, atomic rename to
+                        # the .1 generation, start fresh — a reader of
+                        # either file only ever sees whole JSON lines
+                        self._fh.close()
+                        self._fh = None
+                        os.replace(self._path, self._path + ".1")
+                        self._fh = open(self._path, "a")
+                        self._size = 0
                     self._fh.write(line)
                     self._fh.flush()
+                    self._size += len(line)
                 except OSError:
                     registry.counter(names.EVENTS_DROPPED).inc()
 
@@ -112,15 +152,17 @@ def get_event_log() -> EventLog:
 
 
 def reset_event_log(path: Optional[str] = None,
-                    from_env: bool = False) -> EventLog:
+                    from_env: bool = False,
+                    max_bytes: Optional[int] = None) -> EventLog:
     """Swap in a fresh event log (tests; ``from_env`` re-reads
-    ``KNN_TPU_OBS_LOG``)."""
+    ``KNN_TPU_OBS_LOG``; ``max_bytes`` overrides the rotation cap)."""
     global _log
     with _state_lock:
         if _log is not None:
             _log.close()
         _log = EventLog(
-            os.environ.get(LOG_ENV) or None if from_env else path)
+            os.environ.get(LOG_ENV) or None if from_env else path,
+            max_bytes=max_bytes)
         return _log
 
 

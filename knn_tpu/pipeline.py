@@ -83,6 +83,9 @@ class JobResult:
 
         if obs.enabled():
             out["obs"] = obs.compact_snapshot()
+            # the judgment layer over the snapshot: one burn-rate
+            # evaluation pass per metrics() render (knn_tpu.obs.slo)
+            out["slo"] = obs.slo_report()
         return out
 
     def metrics_json(self) -> str:

@@ -56,21 +56,39 @@ from knn_tpu.serving.buckets import (
 OPS = ("search", "predict")
 
 
-def latency_summary(samples_s: Sequence[float]) -> Optional[Dict[str, float]]:
+def latency_summary(samples_s: Sequence) -> Optional[Dict[str, float]]:
     """p50/p95/p99/mean (milliseconds) of per-request wall latencies —
     the engine feeds its bounded recent-request window (``count`` is the
-    window's fill, not the lifetime request total; see stats())."""
+    window's fill, not the lifetime request total; see stats()).
+
+    Samples may be plain durations or ``(monotonic_ts, duration)``
+    pairs; with timestamps the summary also labels WHICH window the
+    quantiles cover — ``window_samples`` (the fill, same number as
+    ``count``) and ``window_span_s`` (wall span from oldest to newest
+    windowed sample) — so a consumer doing burn-rate math can never
+    mistake a window quantile for a lifetime one."""
     if not samples_s:
         return None
-    arr = np.asarray(samples_s, dtype=np.float64) * 1e3
-    return {
+    first = samples_s[0]
+    ts = None
+    if isinstance(first, tuple):
+        ts = [t for t, _ in samples_s]
+        vals = [v for _, v in samples_s]
+    else:
+        vals = samples_s
+    arr = np.asarray(vals, dtype=np.float64) * 1e3
+    out = {
         "p50": round(float(np.percentile(arr, 50)), 3),
         "p95": round(float(np.percentile(arr, 95)), 3),
         "p99": round(float(np.percentile(arr, 99)), 3),
         "mean": round(float(arr.mean()), 3),
         "max": round(float(arr.max()), 3),
         "count": int(arr.size),
+        "window_samples": int(arr.size),
     }
+    if ts is not None:
+        out["window_span_s"] = round(max(ts) - min(ts), 3)
+    return out
 
 
 class PendingSearch:
@@ -184,14 +202,21 @@ class ServingEngine:
         self._requests = 0
         self._queries = 0
         self._errors = 0
-        #: bounded sample window: a long-running service must not grow a
-        #: per-request list forever, and stats() percentiles over the
-        #: recent window are the operationally useful number anyway —
-        #: lifetime counts live in requests_total/queries_total above
+        #: bounded sample window of (monotonic ts, seconds) pairs: a
+        #: long-running service must not grow a per-request list
+        #: forever, and stats() percentiles over the recent window are
+        #: the operationally useful number anyway — lifetime counts
+        #: live in requests_total/queries_total above; the timestamps
+        #: let latency_summary label the window's wall span
         self._latencies_s: deque = deque(maxlen=int(latency_window))
+        #: ops whose buckets have all been AOT-compiled (warmup());
+        #: the readiness probe (/healthz) gates on this being non-empty
+        self.warmed_ops: set = set()
         # every XLA compile this engine triggers lands in the registry
         # (count + seconds), not just the per-bucket tallies above
         obs.install_compile_hook()
+        # readiness/self-diagnosis surface (/healthz, /statusz, doctor)
+        obs.health.register_engine(self)
 
     # -- compile cache -----------------------------------------------------
     def _jit_fn(self, op: str):
@@ -303,6 +328,7 @@ class ServingEngine:
             with self._lock:  # concurrent cold compiles mutate _execs
                 keys = list(self._execs)
             counts[op] = len({k for k in keys if k[0] == op})
+            self.warmed_ops.add(op)  # /healthz readiness flips here
         info = self._tuning_info()
         if (info and info.get("resolved_knobs", {}).get("precision")
                 == "int8"):
@@ -433,7 +459,7 @@ class ServingEngine:
                         trace_id: Optional[str] = None,
                         rows: Optional[int] = None) -> None:
         with self._lock:
-            self._latencies_s.append(seconds)
+            self._latencies_s.append((time.monotonic(), seconds))
         # the registry histogram is the machine-scrapable counterpart of
         # stats()["latency_ms"]: every sample feeds both, but each keeps
         # its own bounded percentile window (latency_window here, the
@@ -475,13 +501,23 @@ class ServingEngine:
         self._tuning_memo = memo
         return memo
 
-    def stats(self) -> dict:
+    def stats(self, *, include_slo: bool = True) -> dict:
         """Compile/dispatch accounting + request latency percentiles —
-        the serving metrics JobResult/bench surface."""
+        the serving metrics JobResult/bench surface.  When telemetry is
+        enabled, also carries the ``slo`` section: one burn-rate
+        evaluation pass over the process-wide objectives
+        (knn_tpu.obs.slo) — so every stats() consumer sees breach state
+        next to the raw numbers it would otherwise misjudge.
+        ``include_slo=False`` skips that pass for callers that already
+        ran their own (the health report evaluates once and reads every
+        engine's raw stats alongside)."""
         tuning_info = self._tuning_info()
+        slo_section = (obs.slo_report()
+                       if include_slo and obs.enabled() else None)
         with self._lock:
             return {
                 **({"tuning": tuning_info} if tuning_info else {}),
+                **({"slo": slo_section} if slo_section else {}),
                 "buckets": list(self.buckets),
                 "compile_count": int(sum(self._compiles.values())),
                 "executables": len(self._execs),

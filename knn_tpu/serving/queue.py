@@ -81,11 +81,13 @@ class QueryQueue:
         #: max-wait deadline is currently holding
         self._g_depth_req = obs.gauge(mn.QUEUE_DEPTH_REQUESTS)
         self._g_depth_rows = obs.gauge(mn.QUEUE_DEPTH_ROWS)
-        #: ARRIVAL-to-result latency of queued requests (bounded window):
-        #: the engine's own percentiles start at engine dispatch and so
-        #: exclude the micro-batching wait — this one is what a caller
-        #: tuning max_wait_ms actually experiences.  deque.append is
-        #: atomic, so the completer records without taking the cond.
+        #: ARRIVAL-to-result latency of queued requests (bounded window
+        #: of (monotonic ts, seconds) pairs, so the summary can label
+        #: its wall span): the engine's own percentiles start at engine
+        #: dispatch and so exclude the micro-batching wait — this one is
+        #: what a caller tuning max_wait_ms actually experiences.
+        #: deque.append is atomic, so the completer records without
+        #: taking the cond.
         self._lat: deque = deque(maxlen=4096)
         self._done: _queue.Queue = _queue.Queue()
         self._batcher_t = threading.Thread(
@@ -94,6 +96,8 @@ class QueryQueue:
             target=self._completer, name="knn-serving-completer", daemon=True)
         self._batcher_t.start()
         self._completer_t.start()
+        # worker-thread liveness feeds the readiness probe (/healthz)
+        obs.health.register_queue(self)
 
     # -- client side -------------------------------------------------------
     def submit(self, queries) -> Future:
@@ -259,7 +263,7 @@ class QueryQueue:
                     self._resolve(fut, (d[lo:hi], i[lo:hi]))
                 else:
                     self._resolve(fut, res[lo:hi])
-                self._lat.append(done_t - t_arr)
+                self._lat.append((done_t, done_t - t_arr))
                 # arrival-to-result under the request's own trace id —
                 # what a caller tuning max_wait_ms actually experiences
                 obs.histogram(mn.QUEUE_REQUEST_LATENCY).observe(

@@ -7,18 +7,32 @@
 #
 # --fast: the inner-loop subset — kernel parity (tiled vs streaming vs
 # int8 bitwise contracts) + quantization bound soundness + the autotuner
-# gate + the telemetry registry/exporters (docs/OBSERVABILITY.md; the
-# metric-name lint rides along so an undocumented metric fails here, not
-# in review) — for edit-compile-test cycles on kernel/emitter/obs code
-# (~tens of seconds instead of the full suite).  The full gate remains
-# the only gate that counts; --fast is a developer convenience
-# (docs/PERF.md).
+# gate + the telemetry registry/exporters + the SLO engine and perf
+# sentinel (docs/OBSERVABILITY.md; the metric-name lint and the
+# sentinel's config lint ride along so an undocumented metric or a
+# broken SLO config fails here, not in review; the sentinel's
+# check-latest pass prints regression verdicts WARN-ONLY) — for
+# edit-compile-test cycles on kernel/emitter/obs code (~tens of seconds
+# instead of the full suite).  The full gate remains the only gate that
+# counts; --fast is a developer convenience (docs/PERF.md).
+#
+# --strict: the full gate PLUS the perf sentinel as a HARD gate — any
+# `regress` verdict on the newest curated bench round against its
+# history fails the run (docs/OBSERVABILITY.md "Regression sentinel").
 cd "$(dirname "$0")/.." || exit 1
 if [ "${1:-}" = "--fast" ]; then
   python scripts/lint_metric_names.py || exit 1
+  python scripts/perf_sentinel.py --lint || exit 1
+  python scripts/perf_sentinel.py --check-latest || true  # warn-only here
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_pallas_knn.py tests/test_pallas_streaming.py \
     tests/test_quantize.py tests/test_tuning.py tests/test_obs.py \
+    tests/test_slo.py tests/test_sentinel.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+if [ "${1:-}" = "--strict" ]; then
+  python scripts/lint_metric_names.py || exit 1
+  python scripts/perf_sentinel.py --lint || exit 1
+  python scripts/perf_sentinel.py --check-latest --strict || exit 1
 fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel CLI (knn_tpu.obs.sentinel) — jax-free.
+
+Three modes, all reading the repo's recorded bench history
+(``TPU_BENCH_r*.jsonl`` + ``BENCH_r*.json``), never timing anything:
+
+``--lint``
+    CI config validation: the SLO objectives (defaults or
+    ``KNN_TPU_SLO_CONFIG``) parse and reference only cataloged metrics,
+    and the bench history parses into baselines.  This is what
+    ``scripts/check_tier1.sh --fast`` runs — a broken SLO config or a
+    corrupted history fixture fails here, not at serve time.
+
+``--check-latest``
+    Judge the NEWEST curated round's lines against baselines built from
+    strictly earlier rounds (a round never seeds the baseline it is
+    judged against).  Prints one verdict line per config.  Warn-only by
+    default; ``--strict`` exits 1 if any line regresses (the
+    ``check_tier1.sh --strict`` hard gate).
+
+``--line FILE``
+    Render the sentinel block for a single bench JSON line (``-`` for
+    stdin) against the full history — what ``bench.py`` embeds on every
+    emitted line, runnable standalone for a line measured elsewhere.
+
+Default (no mode flag): print the baseline table.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from knn_tpu.obs import sentinel  # noqa: E402 - path set above
+
+
+def _latest_round(repo):
+    rounds = sorted({r for r in (
+        sentinel._file_round(p) for p in glob.glob(
+            os.path.join(repo, "TPU_BENCH_r*.jsonl"))) if r is not None})
+    return rounds[-1] if rounds else None
+
+
+def run_lint(repo) -> int:
+    errors = []
+    try:
+        from knn_tpu.obs import slo
+
+        objs = slo.load_objectives()
+        print(f"slo config: OK ({len(objs)} objectives: "
+              f"{', '.join(o.name for o in objs)})")
+    except Exception as e:  # noqa: BLE001 - every failure is a lint hit
+        errors.append(f"slo config: {type(e).__name__}: {e}")
+    try:
+        records = list(sentinel.iter_history_lines(repo))
+        baselines = sentinel.build_baselines(records)
+        n_fields = sum(len(f) for f in baselines.values())
+        print(f"bench history: OK ({len(records)} records -> "
+              f"{len(baselines)} baseline keys, {n_fields} field "
+              f"baselines)")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"bench history: {type(e).__name__}: {e}")
+    for err in errors:
+        print(f"perf_sentinel --lint: {err}", file=sys.stderr)
+    return 1 if errors else 0
+
+
+def run_check_latest(repo, strict: bool) -> int:
+    latest = _latest_round(repo)
+    if latest is None:
+        print("perf_sentinel: no curated TPU_BENCH_r*.jsonl rounds — "
+              "nothing to check")
+        return 0
+    baselines = sentinel.build_baselines(
+        sentinel.iter_history_lines(repo, max_round=latest))
+    if not baselines:
+        print(f"perf_sentinel: no baselines below round {latest} — "
+              f"history too short, skipping")
+        return 0
+    regressed = []
+    for rec in sentinel.iter_history_lines(repo, max_round=latest + 1):
+        if sentinel._file_round(rec.get("_source", "")) != latest:
+            continue
+        if rec.get("stale") is True:
+            # a republished earlier-round number re-judged against its
+            # own history is noise, not a measurement of this round
+            print(f"{rec.get('metric')}: skipped (stale republication "
+                  f"from round {rec.get('measured_round')})")
+            continue
+        v = sentinel.verdict_for_line(rec, baselines=baselines)
+        worst = v["verdict"]
+        print(f"{rec.get('metric')} [{v['baseline_key']}]: {worst}")
+        for fname, fv in v["fields"].items():
+            detail = (f"value={fv.get('value')} "
+                      f"median={fv.get('baseline_median')} "
+                      f"drop={fv.get('drop_rel')} "
+                      f"sigmas={fv.get('effect_sigmas')}"
+                      if "value" in fv else fv.get("reason", ""))
+            print(f"    {fname}: {fv['verdict']} {detail}")
+        if worst == "regress":
+            regressed.append(rec.get("metric"))
+    if regressed:
+        msg = (f"perf_sentinel: {len(regressed)} regression verdict(s): "
+               f"{', '.join(regressed)}")
+        if strict:
+            print(msg, file=sys.stderr)
+            return 1
+        print(msg + "  (warn-only; --strict hard-fails)")
+    return 0
+
+
+def run_line(repo, path) -> int:
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    rec = json.loads(raw)
+    v = sentinel.verdict_for_line(rec, repo_dir=repo)
+    print(json.dumps(v, indent=1, sort_keys=True))
+    return 0 if v["verdict"] != "regress" else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="perf_sentinel.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--repo", default=REPO,
+                   help="repo/history directory (default: this repo)")
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--lint", action="store_true",
+                      help="validate SLO config + history fixtures")
+    mode.add_argument("--check-latest", action="store_true",
+                      help="judge the newest curated round against "
+                           "earlier rounds")
+    mode.add_argument("--line", metavar="FILE",
+                      help="sentinel block for one bench JSON line "
+                           "('-' = stdin)")
+    p.add_argument("--strict", action="store_true",
+                   help="with --check-latest: exit 1 on any regress")
+    args = p.parse_args(argv)
+    if args.lint:
+        return run_lint(args.repo)
+    if args.check_latest:
+        return run_check_latest(args.repo, args.strict)
+    if args.line:
+        return run_line(args.repo, args.line)
+    baselines = sentinel.build_baselines(
+        sentinel.iter_history_lines(args.repo))
+    print(json.dumps(baselines, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

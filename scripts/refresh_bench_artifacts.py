@@ -162,6 +162,27 @@ for cfg, rec in best.items():
     # round and republished here must say so on its face
     rec["stale"] = rec["measured_round"] < _r
 
+# perf-regression sentinel (knn_tpu.obs.sentinel): every curated line
+# carries its verdict against the robust baseline of STRICTLY EARLIER
+# rounds (a line never seeds the baseline it is judged against); stale
+# republished lines are skipped — they are not this round's
+# measurement.  Advisory here; check_tier1.sh --strict hard-gates.
+sys.path.insert(0, REPO)
+try:
+    from knn_tpu.obs import sentinel as _sentinel
+
+    _baselines = _sentinel.build_baselines(
+        _sentinel.iter_history_lines(REPO, max_round=_r))
+    for cfg, rec in best.items():
+        if rec["stale"]:
+            rec.pop("sentinel", None)  # stale carry: old verdict drops
+            continue
+        rec["sentinel"] = _sentinel.verdict_for_line(
+            rec, baselines=_baselines)
+except Exception as _e:  # noqa: BLE001 — curation must never fail on it
+    print(f"sentinel verdicts skipped: {type(_e).__name__}: {_e}",
+          file=sys.stderr)
+
 with open(DST, "w") as f:
     for cfg in order:
         f.write(json.dumps(best[cfg]) + "\n")
@@ -174,4 +195,6 @@ with open(DST, "w") as f:
               # it (bench.py KNN_BENCH_OBS_OVERHEAD); curated verbatim
               + (f" obs_overhead={r['obs_overhead_pct']}%"
                  if "obs_overhead_pct" in r else "")
+              + (f" sentinel={r['sentinel']['verdict']}"
+                 if "sentinel" in r else "")
               + (" STALE" if r["stale"] else ""))

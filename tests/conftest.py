@@ -19,6 +19,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # (tests that exercise the cache pass explicit paths / their own env)
 os.environ["KNN_TPU_TUNE_CACHE"] = os.path.join(
     tempfile.mkdtemp(prefix="knn_tpu_test_tune_"), "autotune.json")
+# isolate the telemetry env knobs the same way: the suite assumes the
+# default-on registry, no ambient JSONL sink, the default rotation cap,
+# and the default SLO objectives (tests that exercise these set their
+# own paths/values explicitly)
+for _knob in ("KNN_TPU_OBS", "KNN_TPU_OBS_LOG",
+              "KNN_TPU_OBS_LOG_MAX_BYTES", "KNN_TPU_SLO_CONFIG"):
+    os.environ.pop(_knob, None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

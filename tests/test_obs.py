@@ -444,6 +444,112 @@ def test_jax_compile_events_counted():
     assert secs > 0
 
 
+# --- JSONL sink rotation -------------------------------------------------
+def test_jsonl_sink_rotates_preserving_valid_jsonl(tmp_path):
+    """A long-running process's event log is size-capped: when the cap
+    is crossed the file rotates to <path>.1 via atomic rename, on a
+    LINE boundary — both sides of the cut must parse as valid JSONL and
+    jointly hold every emitted event."""
+    path = tmp_path / "events.jsonl"
+    # each event line is ~70 bytes; a 1 KiB cap forces several cuts
+    obs.reset_event_log(str(path), max_bytes=1024)
+    n = 200
+    for i in range(n):
+        obs.emit_event("queue.dispatch", rows=i, pad="x" * 16)
+    assert path.exists() and (tmp_path / "events.jsonl.1").exists()
+    live = [json.loads(ln) for ln in path.read_text().splitlines()]
+    rotated = [json.loads(ln) for ln in
+               (tmp_path / "events.jsonl.1").read_text().splitlines()]
+    # both generations are whole JSON lines, under the cap, and the
+    # newest events are in the live file in order
+    assert all(e["name"] == "queue.dispatch" for e in live + rotated)
+    assert path.stat().st_size <= 1024
+    assert (tmp_path / "events.jsonl.1").stat().st_size <= 1024
+    assert [e["rows"] for e in rotated + live] == list(
+        range(n - len(rotated) - len(live), n))
+    # the in-memory ring still holds everything regardless of rotation
+    assert len(obs.get_event_log().recent()) == n
+
+
+def test_jsonl_rotation_keeps_exactly_two_generations(tmp_path):
+    path = tmp_path / "e.jsonl"
+    obs.reset_event_log(str(path), max_bytes=256)
+    for i in range(300):
+        obs.emit_event("queue.dispatch", rows=i)
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == ["e.jsonl", "e.jsonl.1"]  # older generations replaced
+
+
+# --- metrics server under concurrent load --------------------------------
+def test_http_server_concurrent_load_never_tears(placed):
+    """Hammer /metrics, /metrics.json, /healthz, /statusz from several
+    threads while the registry mutates underneath: every response must
+    parse (text exposition / JSON), and no torn snapshot may surface —
+    the server's view is always a consistent point-in-time read."""
+    import urllib.error
+
+    from knn_tpu.serving.engine import ServingEngine
+
+    prog, rng = placed
+    eng = ServingEngine(prog, buckets=(8,))
+    eng.warmup()
+    server = obs.start_metrics_server(0)
+    errors = []
+    stop = threading.Event()
+    try:
+        port = server.server_address[1]
+
+        def mutate():
+            i = 0
+            while not stop.is_set():
+                obs.counter(mn.QUEUE_REQUESTS).inc()
+                obs.histogram(mn.QUEUE_WAIT).observe(i * 1e-4)
+                obs.gauge(mn.QUEUE_DEPTH_ROWS).set(i % 7)
+                i += 1
+
+        def fetch(path, check):
+            try:
+                for _ in range(25):
+                    try:
+                        body = urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}{path}",
+                            timeout=10).read().decode()
+                    except urllib.error.HTTPError as e:
+                        body = e.read().decode()  # /healthz 503 is fine
+                    check(body)
+            except Exception as e:  # noqa: BLE001 — the assertion surface
+                errors.append((path, repr(e)))
+
+        def check_prom(body):
+            assert "# TYPE knn_tpu_queue_requests_total counter" in body
+            for ln in body.splitlines():
+                assert ln.startswith("#") or " " in ln
+
+        def check_json(body):
+            json.loads(body)
+
+        mut = threading.Thread(target=mutate, daemon=True)
+        mut.start()
+        ts = []
+        for _ in range(2):
+            for path, check in (("/metrics", check_prom),
+                                ("/metrics.json", check_json),
+                                ("/healthz", check_json),
+                                ("/statusz", check_json)):
+                ts.append(threading.Thread(target=fetch,
+                                           args=(path, check)))
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        stop.set()
+        mut.join(10)
+        assert not errors, errors
+    finally:
+        stop.set()
+        server.shutdown()
+
+
 # --- the lint gate -------------------------------------------------------
 def test_lint_metric_names_green():
     r = subprocess.run(

@@ -1,0 +1,363 @@
+"""The SLO engine (knn_tpu.obs.slo) and health introspection
+(knn_tpu.obs.health): burn-rate alerts fire exactly once per transition
+and clear on recovery; /healthz gates on warmup + worker liveness;
+/statusz and the doctor CLI render the same report; KNN_TPU_OBS=0
+produces bitwise-identical predictions with the shared no-op handles —
+the acceptance surface of the SLO/health ISSUE."""
+
+import json
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.obs import names as mn
+from knn_tpu.obs import slo
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Every test starts from an empty ENABLED registry, event ring,
+    SLO engine, and health registrations."""
+    obs.reset(enabled=True)
+    obs.reset_event_log(None)
+    obs.reset_slo_engine()
+    obs.health.reset()
+    yield
+    obs.reset()
+    obs.reset_event_log(from_env=True)
+    obs.reset_slo_engine()
+    obs.health.reset()
+
+
+def _alerts():
+    return [e for e in obs.get_event_log().recent()
+            if e.get("name") == "slo.alert"]
+
+
+# --- objective validation ------------------------------------------------
+def test_default_objectives_validate_against_catalog():
+    objs = slo.load_objectives()
+    assert {o.name for o in objs} == {
+        "serving_availability", "serving_request_p99", "queue_wait_p95",
+        "certified_fallback_rate", "certified_false_alarm_rate"}
+    for o in objs:
+        o.validate()  # must not raise
+
+
+def test_config_file_overrides_and_bad_config_rejected(tmp_path, monkeypatch):
+    cfg = tmp_path / "slo.json"
+    cfg.write_text(json.dumps([
+        {"name": "only_availability", "kind": "ratio",
+         "num": mn.SERVING_ERRORS, "den": mn.SERVING_REQUESTS,
+         "target": 0.99},
+    ]))
+    monkeypatch.setenv(slo.CONFIG_ENV, str(cfg))
+    objs = slo.load_objectives()
+    assert [o.name for o in objs] == ["only_availability"]
+    # an uncataloged metric (or a gauge where a counter is needed) fails
+    cfg.write_text(json.dumps([
+        {"name": "bad", "kind": "ratio", "num": "knn_tpu_nope_total",
+         "den": mn.SERVING_REQUESTS, "target": 0.99}]))
+    with pytest.raises(ValueError, match="not a catalog metric"):
+        slo.load_objectives()
+    cfg.write_text(json.dumps([
+        {"name": "bad", "kind": "quantile", "hist": mn.SERVING_REQUESTS,
+         "threshold": 1.0}]))
+    with pytest.raises(ValueError, match="must be a histogram"):
+        slo.load_objectives()
+
+
+# --- burn-rate alerting (the acceptance criterion) -----------------------
+def test_error_burst_trips_alert_exactly_once_and_recovery_clears(tmp_path):
+    log_path = tmp_path / "events.jsonl"
+    obs.reset_event_log(str(log_path))
+    eng = slo.SLOEngine()
+    eng.evaluate(now=0.0)  # baseline counter sample
+    assert _alerts() == []
+
+    # deterministic injected burst: half the requests error
+    obs.counter(mn.SERVING_REQUESTS, op="search").inc(100)
+    obs.counter(mn.SERVING_ERRORS, op="search").inc(50)
+    # cold-start guard: one second of history may not page the slow
+    # window, however hard it burns — the fast window alone never pages
+    rep = eng.evaluate(now=1.0)
+    assert rep["breached"] == []
+    o = rep["objectives"]["serving_availability"]
+    assert o["windows"]["fast"]["confirmable"] is False
+    assert o["windows"]["slow"]["confirmable"] is False
+    assert _alerts() == []
+
+    # once both windows have real history behind them, the sustained
+    # burst breaches
+    rep = eng.evaluate(now=300.0)
+    assert rep["breached"] == ["serving_availability"]
+    o = rep["objectives"]["serving_availability"]
+    # both windows burned far past threshold, and each labels the
+    # ACTUAL span its ratio covers (the window-truth contract)
+    for w in ("fast", "slow"):
+        assert o["windows"][w]["burn_rate"] >= o["burn_threshold"]
+        assert o["windows"][w]["span_s"] == 300.0
+        assert o["windows"][w]["confirmable"] is True
+    # gauge set, transition counted, exactly ONE firing event
+    assert obs.gauge(mn.SLO_BREACHED,
+                     objective="serving_availability").get() == 1.0
+    assert obs.counter(mn.SLO_BREACH_TRANSITIONS,
+                       objective="serving_availability").get() == 1.0
+    fired = _alerts()
+    assert [(a["objective"], a["state"]) for a in fired] == [
+        ("serving_availability", "firing")]
+
+    # still breached on re-evaluation: reported, NOT re-alerted
+    rep = eng.evaluate(now=310.0)
+    assert rep["breached"] == ["serving_availability"]
+    assert len(_alerts()) == 1
+    assert obs.counter(mn.SLO_BREACH_TRANSITIONS,
+                       objective="serving_availability").get() == 1.0
+
+    # recovery: error-free traffic, windows age past the burst
+    obs.counter(mn.SERVING_REQUESTS, op="search").inc(1000)
+    rep = eng.evaluate(now=700.0)
+    assert rep["breached"] == []
+    assert obs.gauge(mn.SLO_BREACHED,
+                     objective="serving_availability").get() == 0.0
+    states = [(a["objective"], a["state"]) for a in _alerts()]
+    assert states == [("serving_availability", "firing"),
+                      ("serving_availability", "resolved")]
+    # the JSONL sink carries the same two alert events
+    lines = [json.loads(ln) for ln in log_path.read_text().splitlines()]
+    jl = [(e["objective"], e["state"]) for e in lines
+          if e.get("name") == "slo.alert"]
+    assert jl == states
+
+
+def test_quantile_objective_breach_labels_window():
+    eng = slo.SLOEngine()
+    h = obs.histogram(mn.SERVING_REQUEST_LATENCY, op="search")
+    for _ in range(20):
+        h.observe(3.0)  # p99 = 3.0 s >> the 1.0 s threshold
+    rep = eng.evaluate(now=0.0)
+    o = rep["objectives"]["serving_request_p99"]
+    assert o["breached"] is True
+    assert o["value_s"] == pytest.approx(3.0)
+    assert o["burn_rate"] == pytest.approx(3.0)
+    # the quantile names WHICH window it came from: sample count + span
+    assert o["window_samples"] == 20
+    assert o["window_span_s"] is not None
+    assert [(a["objective"], a["state"]) for a in _alerts()] == [
+        ("serving_request_p99", "firing")]
+
+
+def test_concurrent_evaluations_emit_exactly_one_firing_alert():
+    import threading
+
+    eng = slo.SLOEngine()
+    eng.evaluate(now=0.0)
+    obs.counter(mn.SERVING_REQUESTS, op="search").inc(100)
+    obs.counter(mn.SERVING_ERRORS, op="search").inc(100)
+    barrier = threading.Barrier(8)
+
+    def run():
+        barrier.wait()
+        eng.evaluate(now=300.0)
+
+    ts = [threading.Thread(target=run) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # evaluation is serialized: 8 racing callers, ONE transition
+    fired = [a for a in _alerts() if a["state"] == "firing"
+             and a["objective"] == "serving_availability"]
+    assert len(fired) == 1
+    assert obs.counter(mn.SLO_BREACH_TRANSITIONS,
+                       objective="serving_availability").get() == 1.0
+
+
+def test_single_sample_never_breaches_ratio():
+    eng = slo.SLOEngine()
+    obs.counter(mn.SERVING_REQUESTS, op="search").inc(10)
+    obs.counter(mn.SERVING_ERRORS, op="search").inc(10)
+    # first-ever evaluation has no prior sample to delta against
+    rep = eng.evaluate(now=0.0)
+    assert rep["breached"] == []
+
+
+# --- disabled mode (bitwise + no obs objects) ----------------------------
+def test_disabled_mode_slo_is_shared_noop_and_predictions_bitwise(rng):
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+    from knn_tpu.serving.engine import ServingEngine
+
+    db = rng.standard_normal((256, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5)
+
+    eng_on = ServingEngine(prog, buckets=(8,))
+    d_on, i_on = eng_on.submit(q).result()
+    assert "slo" in eng_on.stats()
+
+    obs.reset(enabled=False)
+    obs.reset_slo_engine()
+    assert obs.get_slo_engine() is slo.NOOP_SLO  # ONE shared inert engine
+    assert obs.slo_report() == {}
+    eng_off = ServingEngine(prog, buckets=(8,))
+    d_off, i_off = eng_off.submit(q).result()
+    # same workload, bitwise-identical predictions, no slo section
+    np.testing.assert_array_equal(i_on, i_off)
+    np.testing.assert_array_equal(d_on, d_off)
+    assert "slo" not in eng_off.stats()
+    # and no health registration rode the disabled engine
+    assert obs.health.probe()["ready"] is False
+
+
+# --- stats window labeling (the window-vs-lifetime fix) ------------------
+def test_latency_summaries_label_their_window(rng):
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+    from knn_tpu.serving.engine import ServingEngine
+
+    db = rng.standard_normal((256, 16)).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5)
+    eng = ServingEngine(prog, buckets=(8,), latency_window=2)
+    q = rng.standard_normal((3, 16)).astype(np.float32)
+    for _ in range(5):
+        eng.submit(q).result()
+    lat = eng.stats()["latency_ms"]
+    # the quantiles say which window they cover: 2 samples, a real span
+    assert lat["count"] == lat["window_samples"] == 2
+    assert lat["window_span_s"] >= 0.0
+    # the registry histogram labels its window the same way
+    s = obs.histogram(mn.SERVING_REQUEST_LATENCY, op="search").summary()
+    assert s["count"] == 5 and s["window"] == 5
+    assert s["window_span_s"] >= 0.0
+
+
+# --- health endpoints (the acceptance criterion) -------------------------
+def _get(port, path):
+    try:
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10)
+        return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_healthz_gates_on_warmup_and_worker_liveness(rng):
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+    from knn_tpu.serving.engine import ServingEngine
+    from knn_tpu.serving.queue import QueryQueue
+
+    server = obs.start_metrics_server(0)
+    try:
+        port = server.server_address[1]
+        code, body = _get(port, "/healthz")
+        assert code == 503
+        assert "no ServingEngine registered" in body
+
+        db = rng.standard_normal((256, 16)).astype(np.float32)
+        prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5)
+        eng = ServingEngine(prog, buckets=(8, 16))
+        code, body = _get(port, "/healthz")
+        assert code == 503  # registered but NOT warmed
+        assert "warmup" in body
+
+        eng.warmup()
+        code, body = _get(port, "/healthz")
+        assert code == 200
+        assert json.loads(body) == {"live": True, "ready": True,
+                                    "reasons": []}
+        assert obs.gauge(mn.HEALTH_READY).get() == 1.0
+
+        with QueryQueue(eng, max_wait_ms=5.0) as qq:
+            qq.submit(rng.standard_normal((3, 16)).astype(
+                np.float32)).result(timeout=60)
+            code, _ = _get(port, "/healthz")
+            assert code == 200
+            # a dead worker thread flips readiness (simulate by closing
+            # outside the context manager is graceful — so poke the
+            # probe's thread check directly with a closed flag unset)
+        # after a GRACEFUL close the queue reports closed, not dead
+        code, _ = _get(port, "/healthz")
+        assert code == 200
+        # an abandoned queue whose threads died without closing = 503
+        qq._closed = False
+        code, body = _get(port, "/healthz")
+        assert code == 503
+        assert "worker thread" in body
+        qq._closed = True
+    finally:
+        server.shutdown()
+
+
+def test_statusz_and_doctor_render_the_same_report(rng, tmp_path):
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+    from knn_tpu.serving.engine import ServingEngine
+
+    db = rng.standard_normal((256, 16)).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5)
+    eng = ServingEngine(prog, buckets=(8,))
+    eng.warmup()
+    eng.submit(rng.standard_normal((4, 16)).astype(np.float32)).result()
+
+    server = obs.start_metrics_server(0)
+    try:
+        port = server.server_address[1]
+        code, body = _get(port, "/statusz")
+        assert code == 200
+        live = json.loads(body)
+        assert live["readiness"]["ready"] is True
+        assert live["devices"]["available"] is True
+        assert live["engines"][0]["warmed_ops"] == ["search"]
+        assert live["engines"][0]["requests_total"] == 1
+        assert "serving_availability" in live["slo"]["objectives"]
+        # the doctor renders a live report without error
+        text = obs.health.render_text(live)
+        assert "health: READY" in text
+    finally:
+        server.shutdown()
+
+    # offline: snapshot embeds the same report structure; the jax-free
+    # doctor subcommand renders it with the same code path
+    snap = tmp_path / "snap.json"
+    obs.write_json_snapshot(str(snap))
+    payload = json.loads(snap.read_text())
+    assert payload["health"]["readiness"]["ready"] is True
+    r = subprocess.run(
+        [sys.executable, "-m", "knn_tpu.cli", "doctor",
+         "--snapshot", str(snap)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "health: READY" in r.stdout
+    assert "engine[0]" in r.stdout
+
+    # not-ready state exits 2 (distinguishable from unreadable-source 1)
+    obs.health.reset()
+    snap2 = tmp_path / "snap2.json"
+    obs.write_json_snapshot(str(snap2))
+    r = subprocess.run(
+        [sys.executable, "-m", "knn_tpu.cli", "doctor",
+         "--snapshot", str(snap2)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "NOT READY" in r.stdout
+
+
+def test_job_metrics_carry_slo_section(tmp_path, rng):
+    from knn_tpu.pipeline import JobResult
+    from knn_tpu.utils.config import JobConfig
+
+    res = JobResult(
+        test_labels=np.zeros(2, np.int32), val_labels=None,
+        val_accuracy=None, phase_times={}, total_time=1.0,
+        n_train=2, n_test=2, n_val=0,
+        config=JobConfig(train_file="x", test_file="y"))
+    m = res.metrics()
+    assert "slo" in m and "objectives" in m["slo"]
+    obs.reset(enabled=False)
+    obs.reset_slo_engine()
+    assert "slo" not in res.metrics()
