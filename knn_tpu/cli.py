@@ -35,10 +35,22 @@ the job flags ``--metrics-port`` / ``--obs-log``
     python -m knn_tpu.cli doctor --snapshot /path/run_metrics.json
 
 renders the health/self-diagnosis report (readiness, device inventory,
-engine warmup + queue worker state, SLO breaches, recent alerts) from a
-RUNNING process's ``/statusz`` endpoint or offline from an atomic
-snapshot — the same report either way, jax-free by construction.
-Exit code: 0 healthy, 2 not ready, 1 unreadable source.
+engine warmup + queue worker state, SLO breaches, roofline verdicts,
+recent alerts) from a RUNNING process's ``/statusz`` endpoint or
+offline from an atomic snapshot — the same report either way, jax-free
+by construction.  Exit code: 0 healthy, 2 not ready, 1 unreadable
+source.
+
+    python -m knn_tpu.cli roofline --n 1000000 --dim 128 --k 100 \\
+        --device-kind "TPU v5 lite" [--qps 24199]
+
+renders the analytic roofline model (knn_tpu.obs.roofline) for any
+config OFFLINE and jax-free: per-term HBM-bytes / MXU-FLOP / VPU-select
+breakdown, the predicted ceiling q/s, and the bound class naming the
+resource that caps this config — with ``--qps`` it also prints the
+measured percent of roofline.  The planning companion of the bench's
+per-line ``roofline`` blocks: answer "what would int8 x streaming be
+bounded by at this shape?" before burning chip time on it.
 """
 
 from __future__ import annotations
@@ -331,6 +343,95 @@ def run_doctor(args: argparse.Namespace) -> int:
     return 0 if report.get("readiness", {}).get("ready") else 2
 
 
+def build_roofline_parser() -> argparse.ArgumentParser:
+    from knn_tpu.obs.roofline import BOUND_CLASSES, PEAKS_BY_KIND
+
+    p = argparse.ArgumentParser(
+        prog="knn_tpu roofline",
+        description="Render the analytic roofline model "
+        "(knn_tpu.obs.roofline) for one config, offline and jax-free: "
+        "per-term byte/FLOP/select breakdown, predicted ceiling q/s, "
+        f"and the bound class ({', '.join(BOUND_CLASSES)}).",
+    )
+    p.add_argument("--n", type=int, required=True, help="database rows")
+    p.add_argument("--dim", type=int, required=True, help="feature dim")
+    p.add_argument("--k", type=int, default=100, help="neighbor count")
+    p.add_argument("--nq", type=int, default=4096,
+                   help="queries per sweep (the rate's numerator)")
+    p.add_argument("--selector", default="pallas",
+                   choices=("pallas", "exact", "approx"),
+                   help="pallas = the fused kernel model (knob flags "
+                   "below); exact/approx = the XLA selector model")
+    p.add_argument("--device-kind", default=None, metavar="KIND",
+                   help="peak-table row to model against, e.g. "
+                   f"{', '.join(sorted(PEAKS_BY_KIND))}; unset/unknown "
+                   "= generic-CPU fallback peaks flagged estimated")
+    p.add_argument("--precision", default=None,
+                   choices=("bf16x3", "bf16x3f", "int8", "highest",
+                            "default"),
+                   help="kernel matmul precision (pallas selector)")
+    p.add_argument("--kernel", default=None,
+                   choices=("tiled", "streaming"))
+    p.add_argument("--grid-order", default=None,
+                   choices=("query_major", "db_major"))
+    p.add_argument("--binning", default=None, choices=("grouped", "lane"))
+    p.add_argument("--tile-n", type=int, default=None)
+    p.add_argument("--block-q", type=int, default=None)
+    p.add_argument("--survivors", type=int, default=None)
+    p.add_argument("--margin", type=int, default=28)
+    p.add_argument("--dtype", default=None,
+                   choices=("bfloat16", "float32"),
+                   help="placement dtype (exact/approx selectors)")
+    p.add_argument("--batch", type=int, default=None,
+                   help="queries per device step (exact/approx)")
+    p.add_argument("--devices", type=int, default=1,
+                   help="mesh size (modeled as perfect scaling)")
+    p.add_argument("--qps", type=float, default=None,
+                   help="a measured q/s to attribute: adds "
+                   "roofline_pct to the output")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw model JSON instead of the "
+                   "human-readable rendering")
+    return p
+
+
+def run_roofline(args: argparse.Namespace) -> int:
+    """The `roofline` subcommand — pure arithmetic, no JAX, no device:
+    prints the rendering (or raw JSON) plus ONE trailing JSON line
+    either way, so scripts can consume it like a bench line."""
+    import json
+
+    from knn_tpu.obs import roofline
+
+    if args.selector == "pallas":
+        model = roofline.pallas_cost_model(
+            n=args.n, d=args.dim, k=args.k, nq=args.nq,
+            precision=args.precision, kernel=args.kernel,
+            grid_order=args.grid_order, binning=args.binning,
+            tile_n=args.tile_n, block_q=args.block_q,
+            survivors=args.survivors, margin=args.margin,
+            device_kind=args.device_kind, num_devices=args.devices)
+    else:
+        model = roofline.xla_cost_model(
+            n=args.n, d=args.dim, k=args.k, nq=args.nq,
+            selector=args.selector, dtype=args.dtype, batch=args.batch,
+            margin=args.margin, device_kind=args.device_kind,
+            num_devices=args.devices)
+    block = roofline.attribute(model, args.qps)
+    if args.json:
+        print(json.dumps(block, indent=1, sort_keys=True))
+        return 0
+    sys.stdout.write(roofline.render_text(block))
+    print(json.dumps({
+        "ceiling_qps": block.get("ceiling_qps"),
+        "bound_class": block.get("bound_class"),
+        "roofline_pct": block.get("roofline_pct"),
+        "estimated": block.get("estimated"),
+        "model_version": block.get("model_version"),
+    }))
+    return 0
+
+
 def args_to_config(args: argparse.Namespace) -> JobConfig:
     return JobConfig(
         train_file=args.train,
@@ -376,6 +477,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_metrics(build_metrics_parser().parse_args(argv[1:]))
     if argv[:1] == ["doctor"]:
         return run_doctor(build_doctor_parser().parse_args(argv[1:]))
+    if argv[:1] == ["roofline"]:
+        return run_roofline(build_roofline_parser().parse_args(argv[1:]))
     args = build_parser().parse_args(argv)
     if args.cpu_devices:
         # Must precede backend initialization; env vars are too late when a

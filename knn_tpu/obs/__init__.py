@@ -19,6 +19,13 @@ writes through here instead of keeping private ad-hoc counters:
   either.
 - **Compile hook** (:mod:`knn_tpu.obs.jax_hooks`): every XLA compile's
   count + seconds via ``jax.monitoring``.
+- **Roofline model** (:mod:`knn_tpu.obs.roofline`): the analytic
+  per-config HBM/MXU/VPU cost model behind every ``roofline_pct`` /
+  ``bound_class`` the bench, autotuner, sentinel, and /statusz report —
+  jax-free attribution of the MFU gap per config.
+- **Device trace capture** (:mod:`knn_tpu.obs.profiler`): opt-in
+  ``jax.profiler.trace`` wrapping of bench/tuning runs
+  (``KNN_TPU_PROFILE_DIR``), for the slack the model can't name.
 
 The package itself imports no JAX (jax_hooks defers it), so the CLI's
 flag parsing and the lint script stay import-light.
@@ -27,7 +34,14 @@ Metric catalog, span lifecycle, and overhead numbers:
 ``docs/OBSERVABILITY.md``.
 """
 
-from knn_tpu.obs import health, names, sentinel, slo  # noqa: F401
+from knn_tpu.obs import (  # noqa: F401
+    health,
+    names,
+    profiler,
+    roofline,
+    sentinel,
+    slo,
+)
 from knn_tpu.obs.export import (  # noqa: F401
     compact_snapshot,
     prometheus_text,
@@ -73,7 +87,8 @@ __all__ = [
     "counter", "emit_event", "enabled", "gauge", "get_event_log",
     "get_registry", "get_slo_engine", "health", "histogram",
     "install_compile_hook", "load_objectives", "names", "new_trace_id",
-    "prometheus_text", "record_span", "reset", "reset_event_log",
-    "reset_slo_engine", "sentinel", "slo", "slo_report", "snapshot",
-    "span", "start_metrics_server", "write_json_snapshot",
+    "profiler", "prometheus_text", "record_span", "reset",
+    "reset_event_log", "reset_slo_engine", "roofline", "sentinel", "slo",
+    "slo_report", "snapshot", "span", "start_metrics_server",
+    "write_json_snapshot",
 ]

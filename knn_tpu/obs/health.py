@@ -40,7 +40,7 @@ import time
 import weakref
 from typing import List, Optional
 
-from knn_tpu.obs import names, registry, slo, trace
+from knn_tpu.obs import names, registry, roofline, slo, trace
 
 #: alert events included in the report (newest last)
 REPORT_ALERTS = 20
@@ -147,6 +147,14 @@ def _engine_status(e) -> dict:
         st = e.stats()
     except Exception as ex:  # noqa: BLE001
         return {"error": f"{type(ex).__name__}: {ex}"}
+    # the resolved autotuner winner's roofline verdict (tuning.
+    # resolve_full surfaces it off the cache entry): which bound class
+    # this engine's certified path would be attacking
+    tun = st.get("tuning") or {}
+    rl = {fld: tun.get(fld)
+          for fld in ("roofline_pct", "bound_class",
+                      "roofline_ceiling_qps")
+          if tun.get(fld) is not None}
     return {
         "warmed_ops": sorted(getattr(e, "warmed_ops", ())),
         "buckets": st.get("buckets"),
@@ -156,6 +164,7 @@ def _engine_status(e) -> dict:
         "queries_total": st.get("queries_total"),
         "errors_total": st.get("errors_total"),
         "latency_ms": st.get("latency_ms"),
+        "roofline": rl or None,
     }
 
 
@@ -215,6 +224,10 @@ def report() -> dict:
         "engines": [_engine_status(e) for e in engines],
         "queues": [_queue_status(q) for q in queues],
         "tune_cache": _tune_cache_status(),
+        # every roofline attribution published in this process
+        # (autotuner winners, warm-cache resolves): the named gap per
+        # config, rendered by /statusz and doctor
+        "roofline": roofline.last_reports(),
         "slo": slo_section,
         "active_breaches": (slo_section.get("breached", [])
                             if slo_section else []),
@@ -245,7 +258,8 @@ def report_from_snapshot(payload: dict) -> dict:
         "devices": {"available": False,
                     "reason": "not recorded in this snapshot"},
         "engines": [], "queues": [],
-        "tune_cache": {}, "slo": {}, "active_breaches": [], "alerts": [],
+        "tune_cache": {}, "roofline": {}, "slo": {},
+        "active_breaches": [], "alerts": [],
     }
 
 
@@ -292,6 +306,13 @@ def render_text(rep: dict) -> str:
         lines.append(f"tune_cache: {tc.get('path')} "
                      f"exists={tc.get('exists')} "
                      f"entries={tc.get('entries')}")
+    for cfg, r in (rep.get("roofline") or {}).items():
+        pct = r.get("roofline_pct")
+        pct_s = f"{pct * 100:.1f}% of " if pct is not None else ""
+        est = " [estimated peaks]" if r.get("estimated") else ""
+        lines.append(f"roofline {cfg}: {pct_s}"
+                     f"{r.get('ceiling_qps')} q/s ceiling "
+                     f"({r.get('bound_class')}){est}")
     breaches = rep.get("active_breaches", [])
     lines.append(f"slo breaches: {', '.join(breaches) if breaches else 'none'}")
     for o_name, o in (rep.get("slo", {}).get("objectives", {}) or {}).items():

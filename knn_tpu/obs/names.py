@@ -69,6 +69,12 @@ SLO_EVALUATIONS = "knn_tpu_slo_evaluations_total"
 # --- health introspection (knn_tpu.obs.health) -------------------------
 HEALTH_READY = "knn_tpu_health_ready"
 
+# --- roofline model (knn_tpu.obs.roofline) -----------------------------
+ROOFLINE_PCT = "knn_tpu_roofline_pct"
+ROOFLINE_CEILING_QPS = "knn_tpu_roofline_ceiling_qps"
+ROOFLINE_BOUND = "knn_tpu_roofline_bound"
+ROOFLINE_EVALUATIONS = "knn_tpu_roofline_evaluations_total"
+
 #: name -> (type, label names, help).  Types: "counter" (monotone,
 #: float-valued so second-counters work), "gauge", "histogram" (bounded
 #: sample window + lifetime count/sum; exported as a Prometheus summary).
@@ -196,4 +202,20 @@ CATALOG = {
         "gauge", (),
         "1 when the readiness probe passes (warmup complete, worker "
         "threads live), 0 otherwise; set on every /healthz or report()."),
+    ROOFLINE_PCT: (
+        "gauge", ("config",),
+        "Measured throughput as a fraction of the analytic roofline "
+        "ceiling for the labeled config (knn_tpu.obs.roofline)."),
+    ROOFLINE_CEILING_QPS: (
+        "gauge", ("config",),
+        "Predicted roofline ceiling q/s for the labeled config — the "
+        "slowest of the HBM / MXU / VPU-select terms at device peaks."),
+    ROOFLINE_BOUND: (
+        "gauge", ("config", "class"),
+        "1 for the config's active bound class (hbm_bound / mxu_bound "
+        "/ vpu_select_bound), 0 for the others."),
+    ROOFLINE_EVALUATIONS: (
+        "counter", (),
+        "Roofline attributions published to the registry (autotuner "
+        "winners, warm-cache resolves, bench runs)."),
 }

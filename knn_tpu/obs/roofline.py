@@ -1,0 +1,662 @@
+"""Analytic per-config roofline model — the attribution layer behind
+every MFU number the repo reports.
+
+``mfu`` alone says "6% of peak" without saying what the hardware
+ceiling for *this* config actually is, so nothing in the system could
+name the resource binding a given (shape, precision, kernel, geometry)
+point — the gap ROADMAP item 1 exists to close.  This module computes,
+term by term and jax-free:
+
+- **HBM bytes moved** per sweep: the db operand stream (bf16 hi+lo =
+  4 B/elem, the fused ``bf16x3f`` contraction 6 B/elem, int8 1 B/elem,
+  f32 4 B/elem — mirroring exactly what ``ops.pallas_knn`` streams),
+  the norms/aux block (8 f32 sublane rows; int8 stacks scales under
+  norms, 16 rows), the re-fetched query blocks, and the candidate
+  output round-trip.  Grid order matters: ``query_major`` (and the
+  streaming kernel, inherently query-major) re-streams the full db
+  once per query block; ``db_major`` at single-chunk dims streams it
+  ONCE per sweep (ops.pallas_knn.GRID_ORDERS).
+- **MXU FLOPs**: the distance matmul's *executed* passes (bf16x3 /
+  bf16x3f = 3 MXU passes, f32-"highest" = 6, int8 = 1 counted at the
+  MXU's int8 rate) beside the *useful* 2·nq·n·d the headline MFU
+  divides by.
+- **VPU select cost**: ops per score element for the grouped / lane
+  in-kernel selects and the XLA ``lax.top_k`` / ApproxTopK paths —
+  calibration constants from the measured cost model in docs/PERF.md.
+
+Each term divides by the device's peak (``PEAKS_BY_KIND`` — the single
+source of truth ``bench.py``'s ``_PEAK_BY_KIND`` is now a view over)
+to a time; the slowest term names the ceiling::
+
+    ceiling_qps = nq / max(t_hbm, t_mxu, t_vpu)
+    bound_class in {"hbm_bound", "mxu_bound", "vpu_select_bound"}
+    roofline_pct = measured_qps / ceiling_qps
+
+The ceiling assumes perfect phase overlap and peak-rate execution of
+every term, so ``roofline_pct <= 1`` up to peak-table error — a pct
+near 1 means the config is done and the *model's* bound must move
+(different precision, grid order, geometry); a low pct names
+implementation slack.  Everything here is pure arithmetic on plain
+numbers: the bench, the artifact refresher, the sentinel lint, and the
+``cli roofline`` subcommand all run it without importing JAX.
+
+Derivation, peak-table provenance, and how to read ``bound_class``:
+docs/PERF.md "Roofline model".
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, Optional, Tuple
+
+from knn_tpu.obs import names, registry, trace
+
+#: bump when the model's terms/peaks/output schema change: the tuning
+#: cache embeds this in its key (tuning.cache.roofline_token), so
+#: persisted winners carrying attributions from an older model
+#: self-invalidate instead of republishing a stale verdict
+MODEL_VERSION = 1
+
+#: the three resources a config can exhaust, in tie-break order
+BOUND_CLASSES = ("hbm_bound", "mxu_bound", "vpu_select_bound")
+
+#: per-device-kind peaks (public spec sheets; bf16 column = the table
+#: bench.py carried since round 1, now living here).  ``hbm_gbps`` is
+#: the chip's HBM bandwidth in GB/s; ``int8_flops`` the int8 MXU rate
+#: (2x bf16 on every announced generation; v7's fp8 4614 TF/s stands in
+#: for int8 there); ``vpu_ops`` is the vector-unit element-op rate —
+#: ESTIMATED: v5e is anchored at the ~3.9 Tops/s the measured cost
+#: model in docs/PERF.md calibrated, other kinds scale by their MXU
+#: ratio.  An unknown kind gets no silent default — callers fall back
+#: to GENERIC_CPU_PEAKS with ``estimated`` set.
+PEAKS_BY_KIND: Dict[str, Dict[str, float]] = {
+    "TPU v2":      {"bf16_flops": 46e12,   "int8_flops": 92e12,
+                    "hbm_gbps": 700.0,  "vpu_ops": 0.9e12},
+    "TPU v3":      {"bf16_flops": 123e12,  "int8_flops": 246e12,
+                    "hbm_gbps": 900.0,  "vpu_ops": 2.4e12},
+    "TPU v4":      {"bf16_flops": 275e12,  "int8_flops": 550e12,
+                    "hbm_gbps": 1228.0, "vpu_ops": 5.4e12},
+    "TPU v4i":     {"bf16_flops": 138e12,  "int8_flops": 276e12,
+                    "hbm_gbps": 614.0,  "vpu_ops": 2.7e12},
+    "TPU v5 lite": {"bf16_flops": 197e12,  "int8_flops": 394e12,
+                    "hbm_gbps": 819.0,  "vpu_ops": 3.9e12},
+    "TPU v5e":     {"bf16_flops": 197e12,  "int8_flops": 394e12,
+                    "hbm_gbps": 819.0,  "vpu_ops": 3.9e12},
+    "TPU v5":      {"bf16_flops": 459e12,  "int8_flops": 918e12,
+                    "hbm_gbps": 2765.0, "vpu_ops": 9.1e12},
+    "TPU v5p":     {"bf16_flops": 459e12,  "int8_flops": 918e12,
+                    "hbm_gbps": 2765.0, "vpu_ops": 9.1e12},
+    "TPU v6 lite": {"bf16_flops": 918e12,  "int8_flops": 1836e12,
+                    "hbm_gbps": 1640.0, "vpu_ops": 18.2e12},
+    "TPU v6e":     {"bf16_flops": 918e12,  "int8_flops": 1836e12,
+                    "hbm_gbps": 1640.0, "vpu_ops": 18.2e12},
+    "TPU v6":      {"bf16_flops": 918e12,  "int8_flops": 1836e12,
+                    "hbm_gbps": 1640.0, "vpu_ops": 18.2e12},
+    "TPU v6p":     {"bf16_flops": 1847e12, "int8_flops": 3694e12,
+                    "hbm_gbps": 7370.0, "vpu_ops": 36.6e12},
+    # Ironwood: 4614 TFLOP/s fp8 per chip; bf16 assumed half
+    "TPU v7":      {"bf16_flops": 2307e12, "int8_flops": 4614e12,
+                    "hbm_gbps": 7370.0, "vpu_ops": 45.7e12},
+    "TPU v7x":     {"bf16_flops": 2307e12, "int8_flops": 4614e12,
+                    "hbm_gbps": 7370.0, "vpu_ops": 45.7e12},
+}
+
+#: the generic fallback for CPU backends / unknown device kinds: one
+#: modern core's SIMD matmul (~100 GFLOP/s), dual-channel DRAM
+#: (~25 GB/s), and a vector-select rate in the same ballpark as the
+#: matmul.  Deliberately round numbers — any block computed from them
+#: carries ``estimated: true`` and exists so CPU microbench lines stop
+#: being attribution-blind, not to be defended to a digit.
+GENERIC_CPU_PEAKS: Dict[str, float] = {
+    "bf16_flops": 100e9, "int8_flops": 200e9,
+    "hbm_gbps": 25.0, "vpu_ops": 50e9,
+}
+
+#: db operand stream width per element, by kernel matmul precision —
+#: EXACTLY what ops.pallas_knn._bin_candidates builds: bf16x3 streams
+#: precomputed bf16 hi+lo parts (2+2 B), bf16x3f one 3x-wide bf16
+#: contraction (6 B), int8 the quantized rows (1 B), f32 paths the raw
+#: rows (4 B).  tests/test_roofline.py pins these against the actual
+#: operand arrays' nbytes.
+DB_ELEM_BYTES: Dict[str, int] = {
+    "bf16x3": 4, "bf16x3f": 6, "int8": 1, "highest": 4, "default": 4,
+}
+
+#: f32 sublane rows of the per-tile aux block (norms; int8 stacks
+#: scales under norms) — ops.pallas_knn's aux_rows
+AUX_ROWS: Dict[str, int] = {"int8": 16}
+AUX_ROWS_DEFAULT = 8
+
+#: query operand width per element (int8 queries quantize in the XLA
+#: prologue and stream as int8 + a [block_q, 128] f32 scale block)
+QUERY_ELEM_BYTES: Dict[str, int] = {"int8": 1}
+QUERY_ELEM_BYTES_DEFAULT = 4
+
+#: executed MXU passes over the 2·nq·n·d useful flops, by precision:
+#: bf16x3/bf16x3f reconstruct the f32 product in three bf16 passes,
+#: "highest" is the native six-pass f32 path, int8 and "default" are
+#: one pass (int8 at the int8 rate)
+MXU_PASSES: Dict[str, int] = {
+    "bf16x3": 3, "bf16x3f": 3, "highest": 6, "default": 1, "int8": 1,
+}
+
+#: VPU element-ops per score element for the in-kernel selects — the
+#: measured cost model's calibration (docs/PERF.md: "grouped select
+#: ~12 VPU ops x 4.1e9 score elements"); lane pays ~7 shuffle rounds
+#: per reduction, ~5x more
+SELECT_OPS: Dict[str, float] = {"grouped": 12.0, "lane": 60.0}
+
+#: VPU element-ops per score element for the XLA selectors: a full
+#: ``lax.top_k`` over a db-wide row measured ~30x the distance matmul
+#: (the "selection-bound" finding the Pallas kernel exists to fix);
+#: the hardware ApproxTopK coarse pass plus the count-below compare is
+#: far cheaper.  Rough calibration constants — they set a CEILING, and
+#: both XLA paths sit well under it.
+XLA_SELECT_OPS: Dict[str, float] = {"exact": 32.0, "approx": 12.0}
+
+#: kernel geometry defaults mirrored from ops.pallas_knn (TILE_N /
+#: BLOCK_Q / grouped survivors=2) so this module stays jax-free; a
+#: test pins them against the kernel module's constants
+TILE_N_DEFAULT = 16384
+BLOCK_Q_DEFAULT = 128
+BIN_W = 128
+SURVIVORS_GROUPED_DEFAULT = 2
+DIM_CHUNK = 128
+
+#: matmul dtype widths for the XLA (non-pallas) selectors
+_DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float64": 8}
+_DTYPE_PASSES = {"bfloat16": 1, "float32": 6, "float64": 6}
+
+_METRIC_RE = re.compile(r"^knn_qps_.+_n(?P<n>\d+)_d(?P<d>\d+)_k(?P<k>\d+)$")
+
+_lock = threading.Lock()
+#: config label -> last published compact attribution (/statusz renders
+#: these); bounded so a label-churning process can't grow it forever
+_LAST: Dict[str, dict] = {}
+_LAST_MAX = 16
+#: every label ever published in this process — the publish-once dedup
+#: surface (:func:`was_published`).  Deliberately NOT the bounded
+#: ``_LAST`` store: eviction there must not re-open a label for
+#: re-publication on a warm-cache hot path.  Labels are config shapes,
+#: bounded in practice.
+_PUBLISHED: set = set()
+
+
+def bf16_peak_by_kind() -> Dict[str, float]:
+    """``{device_kind: bf16 MXU peak FLOP/s}`` — the view bench.py's
+    ``_PEAK_BY_KIND`` historically carried, now derived from the one
+    table."""
+    return {kind: rec["bf16_flops"] for kind, rec in PEAKS_BY_KIND.items()}
+
+
+def peaks_for(device_kind: Optional[str] = None,
+              backend: Optional[str] = None) -> Tuple[Dict[str, float], bool]:
+    """(peaks, estimated): the device's peak record, or the generic CPU
+    fallback with ``estimated=True`` when the kind is unknown or the
+    backend is cpu — a flagged estimate beats an attribution-blind
+    line."""
+    if backend != "cpu" and device_kind in PEAKS_BY_KIND:
+        return dict(PEAKS_BY_KIND[device_kind]), False
+    return dict(GENERIC_CPU_PEAKS), True
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+def db_operand_nbytes(n: int, d: int, precision: str) -> Dict[str, int]:
+    """Bytes of the db-side operands ONE full-db stream moves — the
+    values array(s) plus the lane-major aux block — matching the arrays
+    ``ops.pallas_knn._bin_candidates`` actually builds (the property
+    test compares against their ``nbytes``)."""
+    if precision not in DB_ELEM_BYTES:
+        raise ValueError(
+            f"precision {precision!r} not in {sorted(DB_ELEM_BYTES)}")
+    return {
+        "db_values": int(n) * int(d) * DB_ELEM_BYTES[precision],
+        "db_aux": int(n) * AUX_ROWS.get(precision, AUX_ROWS_DEFAULT) * 4,
+    }
+
+
+def _terms_to_verdict(model: dict, nq: int) -> None:
+    """Fill ceiling_qps + bound_class from the per-term times (slowest
+    term is the roofline; ties break in BOUND_CLASSES order)."""
+    terms = model["terms"]
+    times = {
+        "hbm_bound": terms["hbm"]["time_s"],
+        "mxu_bound": terms["mxu"]["time_s"],
+        "vpu_select_bound": terms["vpu_select"]["time_s"],
+    }
+    bound = max(BOUND_CLASSES, key=lambda c: (times[c], -BOUND_CLASSES.index(c)))
+    t = times[bound]
+    model["bound_class"] = bound
+    model["ceiling_qps"] = round(nq / t, 1) if t > 0 else None
+    model["term_times_s"] = {k: round(v, 6) for k, v in times.items()}
+
+
+def pallas_cost_model(
+    *, n: int, d: int, k: int, nq: int,
+    precision: Optional[str] = None, kernel: Optional[str] = None,
+    grid_order: Optional[str] = None, binning: Optional[str] = None,
+    tile_n: Optional[int] = None, block_q: Optional[int] = None,
+    survivors: Optional[int] = None, margin: int = 28,
+    device_kind: Optional[str] = None, backend: Optional[str] = None,
+    num_devices: int = 1, peaks: Optional[Dict[str, float]] = None,
+) -> dict:
+    """The roofline model of one Pallas-selector config (see module
+    docstring for the terms).  ``None`` knobs take the library defaults
+    the kernel itself would (tile 16384, block_q 128, grouped
+    survivors 2).  Sharding is modeled as perfect scaling: each of
+    ``num_devices`` devices streams ``n / num_devices`` rows in
+    parallel."""
+    precision = precision or "bf16x3"
+    kernel = kernel or "tiled"
+    grid_order = grid_order or "query_major"
+    binning = binning or "grouped"
+    tile = int(tile_n or TILE_N_DEFAULT)
+    bq = int(block_q or BLOCK_Q_DEFAULT)
+    estimated = False
+    if peaks is None:
+        peaks, estimated = peaks_for(device_kind, backend)
+
+    n_dev = _ceil_div(n, max(1, int(num_devices)))
+    tile = min(tile, max(BIN_W, _ceil_div(n_dev, BIN_W) * BIN_W))
+    n_tiles = _ceil_div(n_dev, tile)
+    q_blocks = _ceil_div(nq, bq)
+    if binning == "grouped":
+        surv = int(survivors or SURVIVORS_GROUPED_DEFAULT)
+        out_w = surv * BIN_W
+        bound_w = BIN_W
+        sel_ops = SELECT_OPS["grouped"]
+    else:
+        surv = int(survivors or 2)
+        n_bins = max(1, tile // BIN_W)
+        out_w = _ceil_div(n_bins * surv, BIN_W) * BIN_W
+        bound_w = _ceil_div(n_bins, BIN_W) * BIN_W
+        sel_ops = SELECT_OPS["lane"]
+
+    # --- HBM bytes ------------------------------------------------------
+    # db stream passes: query_major (and the inherently query-major
+    # streaming kernel) re-stream the full db once per query block;
+    # db_major streams it ONCE at single-chunk dims but degenerates to
+    # query_major traffic when the innermost chunk axis cycles between
+    # query blocks (ops.pallas_knn.GRID_ORDERS)
+    if grid_order == "db_major" and d <= DIM_CHUNK and kernel == "tiled":
+        db_passes = 1
+    else:
+        db_passes = q_blocks
+    opnd = db_operand_nbytes(n_dev, d, precision)
+    db_stream = db_passes * opnd["db_values"]
+    db_aux = db_passes * opnd["db_aux"]
+    # query blocks re-fetch once per db tile (their mapped index cycles
+    # with the dim-chunk axis); int8 adds the [block_q, 128] f32
+    # per-query scale block per cell
+    q_elem = QUERY_ELEM_BYTES.get(precision, QUERY_ELEM_BYTES_DEFAULT)
+    queries_b = n_tiles * nq * d * q_elem
+    if precision == "int8":
+        queries_b += n_tiles * nq * BIN_W * 4
+    # candidate outputs: every (query block, db tile) cell writes its
+    # disjoint (block_q, out_w) f32+i32 candidates and bound_w bounds
+    # exactly once (the streaming kernel flushes the same total width
+    # once per query block — identical bytes, fewer launches)
+    cand_b = q_blocks * n_tiles * bq * (out_w * 8 + bound_w * 4)
+    hbm_total = db_stream + db_aux + queries_b + cand_b
+    t_hbm = hbm_total / (peaks["hbm_gbps"] * 1e9)
+
+    # --- MXU flops ------------------------------------------------------
+    useful = 2.0 * nq * n * d
+    passes = MXU_PASSES[precision]
+    executed = useful * passes
+    mxu_rate = peaks["int8_flops"] if precision == "int8" else \
+        peaks["bf16_flops"]
+    # executed flops are per-device work summed over the (perfectly
+    # scaled) mesh: each device runs executed/num_devices in parallel
+    t_mxu = executed / max(1, int(num_devices)) / mxu_rate
+
+    # --- VPU select -----------------------------------------------------
+    vpu_ops = nq * float(n) * sel_ops
+    t_vpu = vpu_ops / max(1, int(num_devices)) / peaks["vpu_ops"]
+
+    model = {
+        "model_version": MODEL_VERSION,
+        "selector": "pallas",
+        "device_kind": device_kind,
+        "estimated": estimated,
+        "peaks": {"hbm_gbps": peaks["hbm_gbps"],
+                  "mxu_flops": mxu_rate, "vpu_ops": peaks["vpu_ops"]},
+        "config": {
+            "n": int(n), "d": int(d), "k": int(k), "nq": int(nq),
+            "precision": precision, "kernel": kernel,
+            "grid_order": grid_order, "binning": binning,
+            "tile_n": tile, "block_q": bq, "survivors": surv,
+            "margin": int(margin), "num_devices": int(num_devices),
+        },
+        "terms": {
+            "hbm": {
+                "bytes": {
+                    "db_stream": int(db_stream), "db_aux": int(db_aux),
+                    "queries": int(queries_b),
+                    "candidates_out": int(cand_b),
+                    "total": int(hbm_total),
+                },
+                "db_passes": int(db_passes),
+                "time_s": t_hbm,
+            },
+            "mxu": {
+                "flops_useful": useful, "flops_executed": executed,
+                "passes": passes, "rate_flops": mxu_rate, "time_s": t_mxu,
+            },
+            "vpu_select": {
+                "ops": vpu_ops, "ops_per_elem": sel_ops,
+                "rate_ops": peaks["vpu_ops"], "time_s": t_vpu,
+            },
+        },
+    }
+    _terms_to_verdict(model, nq)
+    return model
+
+
+def xla_cost_model(
+    *, n: int, d: int, k: int, nq: int, selector: str = "exact",
+    dtype: Optional[str] = None, batch: Optional[int] = None,
+    margin: int = 28, device_kind: Optional[str] = None,
+    backend: Optional[str] = None, num_devices: int = 1,
+    peaks: Optional[Dict[str, float]] = None,
+) -> dict:
+    """Roofline for the XLA selectors: ``exact`` (coarse ``lax.top_k``,
+    one db pass) and ``approx`` (ApproxTopK coarse + the count-below
+    certificate matmul, two passes).  The db streams once per
+    ``batch``-query chunk per pass at the placement dtype's width."""
+    if selector not in ("exact", "approx"):
+        raise ValueError(f"xla selector {selector!r} not in "
+                         f"('exact', 'approx')")
+    dtype = dtype or "float32"
+    if dtype not in _DTYPE_BYTES:
+        raise ValueError(f"dtype {dtype!r} not in {sorted(_DTYPE_BYTES)}")
+    bs = int(batch or nq)
+    estimated = False
+    if peaks is None:
+        peaks, estimated = peaks_for(device_kind, backend)
+
+    n_dev = _ceil_div(n, max(1, int(num_devices)))
+    chunks = _ceil_div(nq, bs)
+    passes = 1 if selector == "exact" else 2
+    elem = _DTYPE_BYTES[dtype]
+    db_stream = chunks * passes * n_dev * d * elem
+    db_aux = chunks * passes * n_dev * 4  # f32 row norms
+    queries_b = passes * nq * d * 4
+    cand_b = passes * nq * min(n, k + margin) * 8
+    hbm_total = db_stream + db_aux + queries_b + cand_b
+    t_hbm = hbm_total / (peaks["hbm_gbps"] * 1e9)
+
+    useful = 2.0 * nq * n * d
+    executed = useful * passes * _DTYPE_PASSES[dtype]
+    t_mxu = executed / max(1, int(num_devices)) / peaks["bf16_flops"]
+
+    sel_ops = XLA_SELECT_OPS[selector]
+    vpu_ops = nq * float(n) * sel_ops
+    t_vpu = vpu_ops / max(1, int(num_devices)) / peaks["vpu_ops"]
+
+    model = {
+        "model_version": MODEL_VERSION,
+        "selector": selector,
+        "device_kind": device_kind,
+        "estimated": estimated,
+        "peaks": {"hbm_gbps": peaks["hbm_gbps"],
+                  "mxu_flops": peaks["bf16_flops"],
+                  "vpu_ops": peaks["vpu_ops"]},
+        "config": {
+            "n": int(n), "d": int(d), "k": int(k), "nq": int(nq),
+            "dtype": dtype, "batch": bs, "passes": passes,
+            "margin": int(margin), "num_devices": int(num_devices),
+        },
+        "terms": {
+            "hbm": {
+                "bytes": {
+                    "db_stream": int(db_stream), "db_aux": int(db_aux),
+                    "queries": int(queries_b),
+                    "candidates_out": int(cand_b),
+                    "total": int(hbm_total),
+                },
+                "db_passes": int(chunks * passes),
+                "time_s": t_hbm,
+            },
+            "mxu": {
+                "flops_useful": useful, "flops_executed": executed,
+                "passes": passes * _DTYPE_PASSES[dtype],
+                "rate_flops": peaks["bf16_flops"], "time_s": t_mxu,
+            },
+            "vpu_select": {
+                "ops": vpu_ops, "ops_per_elem": sel_ops,
+                "rate_ops": peaks["vpu_ops"], "time_s": t_vpu,
+            },
+        },
+    }
+    _terms_to_verdict(model, nq)
+    return model
+
+
+def cost_model(*, selector: str = "pallas", **kwargs) -> dict:
+    """One entry point over both model families: ``selector="pallas"``
+    takes the kernel knobs, ``"exact"``/``"approx"`` the XLA placement
+    dtype + batch."""
+    if selector == "pallas":
+        return pallas_cost_model(**kwargs)
+    return xla_cost_model(selector=selector, **kwargs)
+
+
+def attribute(model: dict, measured_qps: Optional[float]) -> dict:
+    """The model plus the measured verdict: ``roofline_pct`` =
+    measured / ceiling (NOT clamped — a pct > 1 means the peak table or
+    a term is wrong, which is a finding, not an error)."""
+    out = dict(model)
+    if measured_qps is not None and model.get("ceiling_qps"):
+        out["measured_qps"] = round(float(measured_qps), 2)
+        out["roofline_pct"] = round(
+            float(measured_qps) / model["ceiling_qps"], 4)
+    else:
+        out["measured_qps"] = None
+        out["roofline_pct"] = None
+    return out
+
+
+def validate_block(block) -> list:
+    """Structural validation of a ``roofline`` block (bench lines,
+    curated artifacts, cache entries).  Returns a list of error
+    strings, empty when well-formed — the refresher refuses malformed
+    blocks and ``perf_sentinel --lint`` sweeps the history with this."""
+    errors = []
+    if not isinstance(block, dict):
+        return [f"roofline block is {type(block).__name__}, not dict"]
+    if not isinstance(block.get("model_version"), int):
+        errors.append("missing/non-int model_version")
+    if block.get("bound_class") not in BOUND_CLASSES:
+        errors.append(f"bound_class {block.get('bound_class')!r} not in "
+                      f"{BOUND_CLASSES}")
+    ceil = block.get("ceiling_qps")
+    if not isinstance(ceil, (int, float)) or ceil <= 0:
+        errors.append(f"ceiling_qps {ceil!r} is not a positive number")
+    pct = block.get("roofline_pct")
+    if pct is not None and not isinstance(pct, (int, float)):
+        errors.append(f"roofline_pct {pct!r} is neither null nor a number")
+    terms = block.get("terms")
+    if not isinstance(terms, dict):
+        errors.append("missing terms breakdown")
+    else:
+        for term in ("hbm", "mxu", "vpu_select"):
+            t = terms.get(term)
+            if not isinstance(t, dict) or \
+                    not isinstance(t.get("time_s"), (int, float)) or \
+                    t["time_s"] < 0:
+                errors.append(f"terms.{term}.time_s missing or negative")
+    return errors
+
+
+def config_label(n: int, d: int, k: int, *, metric: str = "l2",
+                 dtype: Optional[str] = None,
+                 device_kind: Optional[str] = None) -> str:
+    """The registry label one attribution publishes under — the tuning
+    cache key's shape prefix, so a scraped gauge and a cached winner
+    name the same config."""
+    kind = device_kind or "unknown"
+    return (f"{kind}|n{int(n)}|d{int(d)}|k{int(k)}|{metric.lower()}|"
+            f"{dtype or 'float32'}")
+
+
+def publish(label: str, block: dict) -> None:
+    """Export one attribution to the metrics registry + the /statusz
+    store.  No-op when telemetry is disabled (``KNN_TPU_OBS=0``) — the
+    roofline surface is part of the obs opt-in, like every exporter."""
+    if not registry.enabled():
+        return
+    pct = block.get("roofline_pct")
+    if pct is not None:
+        registry.gauge(names.ROOFLINE_PCT, config=label).set(float(pct))
+    if block.get("ceiling_qps"):
+        registry.gauge(names.ROOFLINE_CEILING_QPS, config=label).set(
+            float(block["ceiling_qps"]))
+    bound = block.get("bound_class")
+    if bound in BOUND_CLASSES:
+        for cls in BOUND_CLASSES:
+            registry.gauge(
+                names.ROOFLINE_BOUND, config=label,
+                **{"class": cls}).set(1.0 if cls == bound else 0.0)
+    registry.counter(names.ROOFLINE_EVALUATIONS).inc()
+    compact = {
+        "roofline_pct": pct,
+        "ceiling_qps": block.get("ceiling_qps"),
+        "bound_class": bound,
+        "measured_qps": block.get("measured_qps"),
+        "estimated": bool(block.get("estimated")),
+        "model_version": block.get("model_version"),
+    }
+    with _lock:
+        _LAST.pop(label, None)
+        _LAST[label] = compact
+        while len(_LAST) > _LAST_MAX:
+            _LAST.pop(next(iter(_LAST)))
+        _PUBLISHED.add(label)
+    trace.emit_event("roofline.publish", config=label,
+                     roofline_pct=pct, bound_class=bound)
+
+
+def was_published(label: str) -> bool:
+    """Whether :func:`publish` ever ran for this label in this process
+    (survives the bounded /statusz store's eviction) — the hot-path
+    dedup ``tuning.resolve_full`` consults so a warm-cache resolve
+    publishes once, not once per call."""
+    with _lock:
+        return label in _PUBLISHED
+
+
+def last_reports() -> Dict[str, dict]:
+    """The last published attributions, newest last — the /statusz +
+    doctor surface (empty when nothing published or obs disabled)."""
+    with _lock:
+        return {k: dict(v) for k, v in _LAST.items()}
+
+
+def reset() -> None:
+    """Drop the published-attribution store (test isolation)."""
+    with _lock:
+        _LAST.clear()
+        _PUBLISHED.clear()
+
+
+def block_for_bench_line(rec: dict) -> Optional[dict]:
+    """Best-effort attribution of one bench JSON line from its own
+    fields (metric-name shape, ``pallas_knobs``, ``device_kind``,
+    ``device_phase_qps``/``value``) — what the artifact refresher
+    curates onto lines that predate the in-bench roofline block.
+    Returns None when the line doesn't carry enough to model."""
+    m = _METRIC_RE.match(str(rec.get("metric") or ""))
+    if not m:
+        return None
+    n, d, k = (int(m.group(g)) for g in ("n", "d", "k"))
+    mode = rec.get("mode")
+    device_kind = rec.get("device_kind")
+    backend = rec.get("backend")
+    devices = int(rec.get("devices") or 1)
+    nq = int(rec.get("batch") or 4096)
+    try:
+        if mode == "certified_pallas":
+            knobs = rec.get("pallas_knobs") or {}
+            model = pallas_cost_model(
+                n=n, d=d, k=k, nq=nq,
+                precision=knobs.get("precision") or rec.get("precision"),
+                kernel=knobs.get("kernel"),
+                grid_order=knobs.get("grid_order"),
+                binning=knobs.get("binning"), tile_n=knobs.get("tile_n"),
+                block_q=knobs.get("block_q"),
+                survivors=knobs.get("survivors"),
+                margin=int(knobs.get("margin") or 28),
+                device_kind=device_kind, backend=backend,
+                num_devices=devices)
+            measured = rec.get("device_phase_qps") or rec.get("value")
+        elif mode in ("exact", "certified_approx"):
+            model = xla_cost_model(
+                n=n, d=d, k=k, nq=nq,
+                selector="exact" if mode == "exact" else "approx",
+                dtype=rec.get("compute_dtype"), batch=rec.get("batch"),
+                device_kind=device_kind, backend=backend,
+                num_devices=devices)
+            measured = rec.get("value")
+        else:
+            return None
+    except (ValueError, TypeError):
+        return None
+    return attribute(model, measured)
+
+
+def render_text(block: dict) -> str:
+    """Human-readable rendering of one model/attribution — shared by
+    ``cli roofline`` and doctor so both print the same shape."""
+    cfg = block.get("config", {})
+    lines = []
+    head = (f"roofline v{block.get('model_version')} "
+            f"[{block.get('selector')}] "
+            f"n={cfg.get('n')} d={cfg.get('d')} k={cfg.get('k')} "
+            f"nq={cfg.get('nq')}")
+    if block.get("selector") == "pallas":
+        head += (f" precision={cfg.get('precision')} "
+                 f"kernel={cfg.get('kernel')} "
+                 f"grid={cfg.get('grid_order')} "
+                 f"tile_n={cfg.get('tile_n')} block_q={cfg.get('block_q')}")
+    else:
+        head += f" dtype={cfg.get('dtype')} batch={cfg.get('batch')}"
+    lines.append(head)
+    kind = block.get("device_kind") or "generic-cpu"
+    est = " (ESTIMATED generic fallback peaks)" if block.get(
+        "estimated") else ""
+    lines.append(f"device: {kind}{est}")
+    terms = block.get("terms", {})
+    hb = terms.get("hbm", {})
+    by = hb.get("bytes", {})
+    lines.append(
+        f"  hbm:        {by.get('total', 0) / 1e9:10.3f} GB  "
+        f"-> {hb.get('time_s', 0) * 1e3:9.3f} ms   "
+        f"(db {by.get('db_stream', 0) / 1e9:.3f} GB x "
+        f"{hb.get('db_passes')} passes, aux "
+        f"{by.get('db_aux', 0) / 1e9:.3f}, q "
+        f"{by.get('queries', 0) / 1e9:.3f}, out "
+        f"{by.get('candidates_out', 0) / 1e9:.3f})")
+    mx = terms.get("mxu", {})
+    lines.append(
+        f"  mxu:        {mx.get('flops_executed', 0) / 1e12:10.3f} TFLOP "
+        f"-> {mx.get('time_s', 0) * 1e3:9.3f} ms   "
+        f"({mx.get('passes')}x passes over "
+        f"{mx.get('flops_useful', 0) / 1e12:.3f} useful TFLOP at "
+        f"{mx.get('rate_flops', 0) / 1e12:.0f} TF/s)")
+    vp = terms.get("vpu_select", {})
+    lines.append(
+        f"  vpu_select: {vp.get('ops', 0) / 1e9:10.3f} Gops  "
+        f"-> {vp.get('time_s', 0) * 1e3:9.3f} ms   "
+        f"({vp.get('ops_per_elem')} ops/elem at "
+        f"{vp.get('rate_ops', 0) / 1e12:.1f} Tops/s)")
+    lines.append(f"ceiling: {block.get('ceiling_qps')} q/s "
+                 f"({block.get('bound_class')})")
+    if block.get("roofline_pct") is not None:
+        lines.append(f"measured: {block.get('measured_qps')} q/s = "
+                     f"{block['roofline_pct'] * 100:.1f}% of roofline")
+    return "\n".join(lines) + "\n"

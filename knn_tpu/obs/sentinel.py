@@ -72,14 +72,34 @@ REGRESS_CEIL = 0.40
 MAD_SCALE = 1.4826
 
 #: the curated fields a baseline tracks, with their good direction
-#: (all current fields are higher-is-better throughput/utilization)
+#: (all current fields are higher-is-better throughput/utilization).
+#: ``roofline_pct`` is the model-anchored family: where the raw-qps
+#: fields judge a line against its own HISTORY, percent-of-roofline
+#: judges it against the hardware ceiling the cost model predicts for
+#: its exact config (knn_tpu.obs.roofline) — a geometry change that
+#: legitimately lowers qps but holds its roofline fraction reads ok,
+#: and a same-config run that slides down the ceiling reads as the
+#: regression it is.
 CURATED_FIELDS: Tuple[Tuple[str, str], ...] = (
     ("value", "higher"),
     ("device_phase_qps", "higher"),
     ("serving_sustained_qps", "higher"),
     ("mfu", "higher"),
     ("mfu_device", "higher"),
+    ("roofline_pct", "higher"),
 )
+
+
+def curated_value(rec: dict, fname: str):
+    """One curated field off a history line: top-level first (bench
+    hoists ``roofline_pct`` there), falling back into the line's
+    ``roofline`` block for lines curated before the hoist."""
+    v = rec.get(fname)
+    if v is None and fname == "roofline_pct":
+        block = rec.get("roofline")
+        if isinstance(block, dict):
+            v = block.get("roofline_pct")
+    return v
 
 #: verdict severity order (worst wins the overall verdict)
 _SEVERITY = {"regress": 3, "warn": 2, "ok": 1, "no_baseline": 0}
@@ -185,7 +205,7 @@ def build_baselines(records: Iterable[dict],
             continue
         commit = rec.get("measured_at_commit")
         for fname, _direction in CURATED_FIELDS:
-            v = rec.get(fname)
+            v = curated_value(rec, fname)
             if not isinstance(v, (int, float)):
                 continue
             slot = acc.setdefault(key, {}).setdefault(
@@ -267,7 +287,7 @@ def verdict_for_line(rec: dict, repo_dir: Optional[str] = None,
     overall = "no_baseline"
     base_fields = baselines.get(key, {}) if key else {}
     for fname, direction in CURATED_FIELDS:
-        v = rec.get(fname)
+        v = curated_value(rec, fname)
         if not isinstance(v, (int, float)):
             continue
         base = base_fields.get(fname)

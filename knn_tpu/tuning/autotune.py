@@ -154,6 +154,23 @@ def resolve_full(
     if source == "cache":
         info["winner_ms"] = entry.get("winner_ms")
         info["measured_at"] = entry.get("measured_at")
+        # the winner's roofline verdict rides the resolve (serving
+        # stats / statusz render it without re-deriving anything) and
+        # publishes to the registry ONCE per (process, config) — a
+        # warm-cache hot path must not re-emit per call
+        for fld in ("roofline_pct", "bound_class"):
+            if entry.get(fld) is not None:
+                info[fld] = entry[fld]
+        rl_block = entry.get("roofline")
+        if isinstance(rl_block, dict):
+            from knn_tpu.obs import roofline as _roofline
+
+            label = _roofline.config_label(
+                n, d, k, metric=metric, dtype=dtype,
+                device_kind=device_kind)
+            info["roofline_ceiling_qps"] = rl_block.get("ceiling_qps")
+            if not _roofline.was_published(label):
+                _roofline.publish(label, rl_block)
     return knobs, info
 
 
@@ -295,6 +312,25 @@ def _timed_program(m: int, knobs: Dict[str, object], db_int8=None):
     return run
 
 
+def _candidate_roofline(knobs: Dict[str, object], n: int, d: int, k: int,
+                        nq: int, ms: float, device_kind: str,
+                        backend: str) -> dict:
+    """One candidate's roofline attribution (knn_tpu.obs.roofline):
+    the analytic ceiling for its knob set on this device kind, the
+    measured fraction of it, and the bound class naming the resource
+    to attack.  jax-free arithmetic on the timing already taken."""
+    from knn_tpu.obs import roofline
+
+    model = roofline.pallas_cost_model(
+        n=n, d=d, k=k, nq=nq,
+        precision=knobs["precision"], kernel=knobs["kernel"],
+        grid_order=knobs["grid_order"], binning=knobs["binning"],
+        tile_n=knobs["tile_n"], block_q=knobs["block_q"],
+        survivors=knobs["survivors"],
+        device_kind=device_kind, backend=backend)
+    return roofline.attribute(model, nq / (ms / 1e3) if ms > 0 else None)
+
+
 def _search_once(queries, db, k, margin, knobs):
     """Full certified search under one knob set: (d, i) — the bitwise
     gate surface (final answers, the contract every knob must keep)."""
@@ -339,6 +375,16 @@ def autotune(
     Candidates that raise (a geometry invalid for this shape) are
     recorded ineligible with the error string, not fatal — the grid is
     allowed to overshoot small problems.
+
+    Every timed candidate also gets a **roofline attribution**
+    (knn_tpu.obs.roofline): percent of its analytic ceiling plus the
+    bound class naming the binding resource, with the winner's full
+    block persisted in the cache entry (``roofline_pct`` /
+    ``bound_class`` hoisted) — the tune record reports how far every
+    point sits from the hardware, not just who won.  With
+    ``KNN_TPU_PROFILE_DIR`` set, one extra fenced run of the winner is
+    captured as an XLA device trace (``entry["trace_dir"]``), outside
+    every timing.
     """
     import jax
 
@@ -379,6 +425,8 @@ def autotune(
     shared_int8 = None
     timings: Dict[str, Optional[float]] = {}
     errors: Dict[str, str] = {}
+    rooflines: Dict[str, dict] = {}
+    backend = jax.default_backend()
     best_label, best_ms, best_knobs = None, None, None
     for cand in candidates:
         knobs = dict(DEFAULT_KNOBS)
@@ -408,6 +456,18 @@ def autotune(
             _bump("candidates_timed")
             ms = float(np.mean(reps)) * 1e3
             timings[label] = round(ms, 3)
+            try:
+                # percent-of-roofline per candidate (the FULL block,
+                # byte/flop term breakdown included): the tune record
+                # reports not just WHO won but how far every point sits
+                # from its own analytic ceiling and which resource caps
+                # it (never fatal — a model gap must not kill a
+                # measurement)
+                rooflines[label] = _candidate_roofline(
+                    knobs, n, d, k, queries.shape[0], ms, device_kind,
+                    backend)
+            except Exception as e:  # noqa: BLE001 — advisory only
+                rooflines[label] = {"error": f"{type(e).__name__}: {e}"}
             if best_ms is None or ms < best_ms:
                 best_label, best_ms, best_knobs = label, ms, knobs
         except Exception as e:  # noqa: BLE001 — per-candidate, recorded
@@ -417,21 +477,56 @@ def autotune(
         raise RuntimeError(
             f"autotune: no eligible candidate for {key} "
             f"(errors: {errors})")
+    # the winner's full roofline attribution persists in the cache
+    # entry (roofline_pct + bound_class hoisted for cheap reads), so a
+    # later warm-cache resolve can surface the verdict — and publish it
+    # to the registry — without re-deriving anything
+    winner_rl = rooflines.get(best_label)
+    if not isinstance(winner_rl, dict) or "ceiling_qps" not in winner_rl:
+        winner_rl = None
+    # opt-in device trace of the winning program (KNN_TPU_PROFILE_DIR;
+    # one extra fenced run OUTSIDE every timing above, so the capture
+    # can never skew a persisted measurement)
+    trace_dir = None
+    from knn_tpu.obs import profiler as _profiler
+
+    if _profiler.profile_dir():
+        try:
+            prog = _timed_program(m, best_knobs, db_int8=shared_int8)
+            with _profiler.device_trace(f"tune_{key}") as tdir:
+                jax.block_until_ready(prog(qj, tj))
+            trace_dir = tdir
+        except Exception:  # noqa: BLE001 — capture must not kill the tune
+            pass
     entry = {
         "knobs": best_knobs,
         "winner": best_label,
         "winner_ms": round(best_ms, 3),
         "timings_ms": timings,
         "errors": errors,
+        "roofline_per_candidate": rooflines,
         "gate": "bitwise-vs-reference",
         "runs": int(runs),
         "n_queries": int(queries.shape[0]),
         "margin": int(margin),
         "device_kind": device_kind,
-        "backend": jax.default_backend(),
+        "backend": backend,
         "jax_version": jax.__version__,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if winner_rl is not None:
+        entry["roofline"] = winner_rl
+        entry["roofline_pct"] = winner_rl["roofline_pct"]
+        entry["bound_class"] = winner_rl["bound_class"]
+    if trace_dir:
+        entry["trace_dir"] = trace_dir
     cache.put(key, entry)
+    if winner_rl is not None:
+        from knn_tpu.obs import roofline as _roofline
+
+        _roofline.publish(
+            _roofline.config_label(n, d, k, metric=metric, dtype=dtype,
+                                   device_kind=device_kind),
+            winner_rl)
     return {**entry, "cached": False, "cache_key": key,
             "cache_path": cache.path}

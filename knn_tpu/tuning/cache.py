@@ -76,16 +76,35 @@ def kernel_version_token() -> str:
         return "unknown"
 
 
+def roofline_token() -> str:
+    """The roofline model version baked into every cache key: since
+    entries carry the winner's ``roofline_pct``/``bound_class``
+    attribution, an entry written under an older (or no) model would
+    republish a verdict the current model never rendered — so the key
+    version-bumps (the same self-invalidation mechanism as
+    ``kv<KERNEL_VERSION>``) and pre-roofline entries fall back to
+    defaults cleanly instead of carrying stale attributions."""
+    try:
+        from knn_tpu.obs.roofline import MODEL_VERSION
+
+        return str(MODEL_VERSION)
+    except Exception:  # pragma: no cover - import failure -> never match
+        return "unknown"
+
+
 def cache_key(device_kind: str, n: int, d: int, k: int, metric: str,
               dtype: Optional[str]) -> str:
     """The shape key a winner is valid for.  ``dtype`` is the placement
     compute dtype (None = float32, the library default); any field
     mismatch MUST miss — a winner tuned for one shape says nothing
-    about another.  The trailing ``kv<version>`` token ties the entry to
-    the kernel code that was measured (:func:`kernel_version_token`);
-    pre-token entries (no ``|kv`` suffix) miss the same way."""
+    about another.  The trailing ``rl<version>|kv<version>`` tokens tie
+    the entry to the roofline-model schema its attribution was rendered
+    under (:func:`roofline_token`) and the kernel code that was
+    measured (:func:`kernel_version_token`); pre-token entries (no
+    ``|rl``/``|kv`` suffix) miss the same way."""
     return (f"{device_kind}|n{int(n)}|d{int(d)}|k{int(k)}|"
             f"{metric.lower()}|{dtype or 'float32'}"
+            f"|rl{roofline_token()}"
             f"|kv{kernel_version_token()}")
 
 

@@ -7,10 +7,11 @@
 #
 # --fast: the inner-loop subset — kernel parity (tiled vs streaming vs
 # int8 bitwise contracts) + quantization bound soundness + the autotuner
-# gate + the telemetry registry/exporters + the SLO engine and perf
-# sentinel (docs/OBSERVABILITY.md; the metric-name lint and the
-# sentinel's config lint ride along so an undocumented metric or a
-# broken SLO config fails here, not in review; the sentinel's
+# gate + the telemetry registry/exporters + the SLO engine, perf
+# sentinel, and roofline cost model (docs/OBSERVABILITY.md; the
+# metric-name lint and the sentinel's config/roofline-block lint ride
+# along so an undocumented metric, a broken SLO config, or a malformed
+# roofline block fails here, not in review; the sentinel's
 # check-latest pass prints regression verdicts WARN-ONLY) — for
 # edit-compile-test cycles on kernel/emitter/obs code (~tens of seconds
 # instead of the full suite).  The full gate remains the only gate that
@@ -27,7 +28,7 @@ if [ "${1:-}" = "--fast" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_pallas_knn.py tests/test_pallas_streaming.py \
     tests/test_quantize.py tests/test_tuning.py tests/test_obs.py \
-    tests/test_slo.py tests/test_sentinel.py \
+    tests/test_slo.py tests/test_sentinel.py tests/test_roofline.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "${1:-}" = "--strict" ]; then

@@ -7,7 +7,10 @@ Three modes, all reading the repo's recorded bench history
 ``--lint``
     CI config validation: the SLO objectives (defaults or
     ``KNN_TPU_SLO_CONFIG``) parse and reference only cataloged metrics,
-    and the bench history parses into baselines.  This is what
+    the bench history parses into baselines, and every ``roofline``
+    block a history line carries is structurally valid
+    (knn_tpu.obs.roofline.validate_block — a malformed block would
+    poison the roofline_pct baselines silently).  This is what
     ``scripts/check_tier1.sh --fast`` runs — a broken SLO config or a
     corrupted history fixture fails here, not at serve time.
 
@@ -64,6 +67,30 @@ def run_lint(repo) -> int:
               f"baselines)")
     except Exception as e:  # noqa: BLE001
         errors.append(f"bench history: {type(e).__name__}: {e}")
+        records = []
+    try:
+        from knn_tpu.obs import roofline
+
+        n_blocks, n_errored = 0, 0
+        for rec in records:
+            block = rec.get("roofline")
+            if block is None:
+                continue
+            if isinstance(block, dict) and "error" in block:
+                # bench's advisory degradation (a model gap recorded as
+                # {"error": ...}) is a designed outcome, not a lint hit
+                # — the same carve-out the artifact refresher applies
+                n_errored += 1
+                continue
+            n_blocks += 1
+            for err in roofline.validate_block(block):
+                errors.append(
+                    f"roofline block on {rec.get('metric')} "
+                    f"({rec.get('_source')}): {err}")
+        print(f"roofline blocks: OK ({n_blocks} validated, "
+              f"{n_errored} advisory-error blocks skipped)")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"roofline blocks: {type(e).__name__}: {e}")
     for err in errors:
         print(f"perf_sentinel --lint: {err}", file=sys.stderr)
     return 1 if errors else 0

@@ -20,7 +20,14 @@ the round being curated, i.e. the number was republished from an
 earlier round rather than re-measured).  The round-5 verdict flagged
 GloVe/GIST republishing round-3 numbers verbatim with no marker; this
 script REFUSES to write any line missing the fields, so an unmarked
-republication can never happen again."""
+republication can never happen again.
+
+Fresh lines additionally carry a roofline attribution
+(knn_tpu.obs.roofline): the bench-embedded block validated (malformed
+blocks REFUSED), pre-roofline lines back-derived from their own config
+fields, and ``roofline_pct``/``bound_class`` hoisted top-level for the
+sentinel's baselines; the per-line print shows the percent and bound
+class beside the sentinel verdict."""
 import json
 import os
 import subprocess
@@ -162,12 +169,45 @@ for cfg, rec in best.items():
     # round and republished here must say so on its face
     rec["stale"] = rec["measured_round"] < _r
 
+# roofline curation (knn_tpu.obs.roofline): every fresh curated line
+# carries a percent-of-roofline attribution — the block the bench
+# embedded (REFUSED if malformed: a corrupt block would silently
+# poison the sentinel's roofline_pct baselines), or one derived from
+# the line's own config fields for lines measured before the in-bench
+# block existed — with roofline_pct hoisted top-level for the
+# sentinel's curated-field baselines.
+sys.path.insert(0, REPO)
+try:
+    from knn_tpu.obs import roofline as _roofline
+
+    for cfg, rec in best.items():
+        if rec["stale"]:
+            continue  # a republished number keeps its old block verbatim
+        block = rec.get("roofline")
+        if block is not None:
+            errs = _roofline.validate_block(block)
+            if errs and "error" not in block:
+                sys.exit(f"refusing to emit curated line for {cfg}: "
+                         f"malformed roofline block: {'; '.join(errs)}")
+        else:
+            block = _roofline.block_for_bench_line(rec)
+            if block is not None:
+                rec["roofline"] = dict(block, derived=True)
+        if isinstance(block, dict) and \
+                block.get("roofline_pct") is not None:
+            rec.setdefault("roofline_pct", block["roofline_pct"])
+            rec.setdefault("bound_class", block.get("bound_class"))
+except SystemExit:
+    raise
+except Exception as _e:  # noqa: BLE001 — curation must never fail on it
+    print(f"roofline curation skipped: {type(_e).__name__}: {_e}",
+          file=sys.stderr)
+
 # perf-regression sentinel (knn_tpu.obs.sentinel): every curated line
 # carries its verdict against the robust baseline of STRICTLY EARLIER
 # rounds (a line never seeds the baseline it is judged against); stale
 # republished lines are skipped — they are not this round's
 # measurement.  Advisory here; check_tier1.sh --strict hard-gates.
-sys.path.insert(0, REPO)
 try:
     from knn_tpu.obs import sentinel as _sentinel
 
@@ -197,4 +237,11 @@ with open(DST, "w") as f:
                  if "obs_overhead_pct" in r else "")
               + (f" sentinel={r['sentinel']['verdict']}"
                  if "sentinel" in r else "")
+              # percent-of-roofline + bound class beside the sentinel
+              # verdict: the history says "slower than before", the
+              # model says "this far from the hardware, bound by THIS"
+              + (f" roofline={r['roofline_pct'] * 100:.1f}%"
+                 f"/{r.get('bound_class')}"
+                 if isinstance(r.get("roofline_pct"), (int, float))
+                 else "")
               + (" STALE" if r["stale"] else ""))

@@ -52,6 +52,16 @@ def test_bench_emits_one_parseable_success_line():
     # the line is self-reproducing: the grid-order knob is part of the
     # recorded pallas geometry
     assert rec["pallas_knobs"]["grid_order"] == "query_major"
+    # roofline attribution beside mfu on the selector entry AND the
+    # line top-level; a CPU run models against the generic fallback
+    # peaks and says so (roofline_estimated)
+    assert sel["roofline"]["bound_class"] in (
+        "hbm_bound", "mxu_bound", "vpu_select_bound")
+    assert sel["roofline"]["roofline_pct"] is not None
+    assert rec["roofline"]["ceiling_qps"] > 0
+    assert rec["roofline_pct"] == rec["roofline"]["roofline_pct"]
+    assert rec["roofline_estimated"] is True
+    assert rec["roofline"]["estimated"] is True
 
 
 def test_bench_bad_config_still_emits_json_line():

@@ -263,3 +263,76 @@ def test_obs_overhead_carryover_marked_stale(tmp_path):
     (r,) = _run(tmp_path, 9, [], seed_lines=[seed])
     assert r["obs_overhead_pct"] == 0.9
     assert r["measured_round"] == 7 and r["stale"] is True
+
+
+def _roofline_block(qps=100.0):
+    from knn_tpu.obs import roofline
+
+    return roofline.attribute(
+        roofline.pallas_cost_model(n=1000, d=16, k=5, nq=8), qps)
+
+
+def _run_with_repo(tmp_path, round_no, lines):
+    """Like _run, but with the REAL repo importable in the subprocess
+    (script execution puts the script dir, not the cwd, on sys.path —
+    in production the refresher lives inside the repo, so knn_tpu
+    resolves; the tmp-dir copy needs PYTHONPATH to match that)."""
+    sdir = tmp_path / "scripts"
+    sdir.mkdir(exist_ok=True)
+    script = sdir / "refresh_bench_artifacts.py"
+    script.write_text(open(SCRIPT).read())
+    (tmp_path / "tpu_bench_lines.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in lines))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, str(script), str(round_no)],
+        capture_output=True, text=True, timeout=60, env=env)
+
+
+def test_roofline_pct_curated_and_printed(tmp_path):
+    # a fresh line carrying a bench-embedded roofline block gets its
+    # pct/bound hoisted top-level (the sentinel's curated field) and
+    # the per-line print shows roofline= beside the sentinel verdict;
+    # a line WITHOUT enough config to model stays block-free
+    block = _roofline_block()
+    with_rl = dict(_line(120.0, gate=True, cfg="knn_qps_rl"),
+                   roofline=block)
+    bare = _line(80.0, gate=True, cfg="knn_qps_bare")
+    r = _run_with_repo(tmp_path, 9, [with_rl, bare])
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "TPU_BENCH_r09.jsonl").read_text().splitlines()]
+    by_cfg = {row["metric"]: row for row in rows}
+    assert by_cfg["knn_qps_rl"]["roofline_pct"] == block["roofline_pct"]
+    assert by_cfg["knn_qps_rl"]["bound_class"] == block["bound_class"]
+    assert "roofline" not in by_cfg["knn_qps_bare"]
+    assert "roofline=" in r.stdout
+
+
+def test_pre_roofline_line_back_derived_from_its_config(tmp_path):
+    # a fresh line measured before the in-bench block existed, but
+    # carrying a modelable config (shape-bearing metric name + mode +
+    # knobs), gets a DERIVED block curated onto it
+    rec = {"metric": "knn_qps_sift1m_n1000000_d128_k100", "value": 6110.0,
+           "backend": "tpu", "mode": "certified_pallas",
+           "device_phase_qps": 24199.3, "device_kind": "TPU v5 lite",
+           "devices": 1, "batch": 4096, "pallas_knobs": {}}
+    r = _run_with_repo(tmp_path, 9, [rec])
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "TPU_BENCH_r09.jsonl").read_text().splitlines()]
+    (row,) = rows
+    assert row["roofline"]["derived"] is True
+    assert row["bound_class"] == "hbm_bound"
+    assert 0.05 < row["roofline_pct"] < 0.3
+
+
+def test_malformed_roofline_block_refused(tmp_path):
+    # a corrupt block would silently poison the sentinel's
+    # roofline_pct baselines — the refresher must refuse the round
+    bad = dict(_line(120.0, gate=True),
+               roofline={"bound_class": "gpu_bound"})
+    r = _run_with_repo(tmp_path, 9, [bad])
+    assert r.returncode != 0
+    assert "malformed roofline block" in (r.stderr + r.stdout)
+    assert not (tmp_path / "TPU_BENCH_r09.jsonl").exists()

@@ -1,0 +1,453 @@
+"""The roofline cost model (knn_tpu.obs.roofline): byte terms pinned
+against the ACTUAL kernel operand arrays' nbytes, ceilings that bound
+real interpret-mode runs, the pinned r05 SIFT1M bound-class
+attribution, the tuning-cache version bump, registry publication, and
+the obs-off no-op — the acceptance surface of the roofline ISSUE."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from knn_tpu import obs, tuning
+from knn_tpu.obs import health, roofline, sentinel
+from knn_tpu.obs import names as mn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate_roofline_store():
+    roofline.reset()
+    yield
+    roofline.reset()
+    obs.reset()
+    health.reset()
+
+
+# --- byte counts vs actual operand nbytes ------------------------------
+
+
+def _actual_operand_nbytes(db, precision):
+    """Build the db-side operand arrays exactly as
+    ops.pallas_knn._bin_candidates does and return their real nbytes."""
+    n = db.shape[0]
+    if precision == "bf16x3":
+        th = db.astype(jnp.bfloat16)
+        tl = (db - th.astype(jnp.float32)).astype(jnp.bfloat16)
+        values = th.nbytes + tl.nbytes
+        aux = jnp.broadcast_to(
+            jnp.sum(db * db, axis=-1)[None, :], (8, n)).nbytes
+    elif precision == "bf16x3f":
+        th = db.astype(jnp.bfloat16)
+        tl = (db - th.astype(jnp.float32)).astype(jnp.bfloat16)
+        t3 = jnp.concatenate([th, tl, th], axis=1)
+        values = t3.nbytes
+        aux = jnp.broadcast_to(
+            jnp.sum(db * db, axis=-1)[None, :], (8, n)).nbytes
+    elif precision == "int8":
+        from knn_tpu.ops.quantize import quantize_rows
+
+        ti, ts = quantize_rows(db)
+        values = ti.nbytes
+        tn = jnp.sum(db * db, axis=-1)
+        aux = jnp.concatenate([
+            jnp.broadcast_to(tn[None, :], (8, n)),
+            jnp.broadcast_to(ts[None, :].astype(jnp.float32), (8, n)),
+        ], axis=0).nbytes
+    else:  # highest / default stream the raw f32 rows
+        values = db.astype(jnp.float32).nbytes
+        aux = jnp.broadcast_to(
+            jnp.sum(db * db, axis=-1)[None, :], (8, n)).nbytes
+    return int(values), int(aux)
+
+
+@pytest.mark.parametrize("precision",
+                         ["bf16x3", "bf16x3f", "int8", "highest"])
+@pytest.mark.parametrize("kernel", ["tiled", "streaming"])
+def test_db_byte_terms_match_actual_operand_nbytes(rng, precision, kernel):
+    """Property: the model's per-pass db byte terms equal the nbytes of
+    the arrays the kernel really streams, for both db-streaming
+    strategies across the f32/bf16/int8 operand families."""
+    n, d = 512, 128
+    db = jnp.asarray(rng.random((n, d), dtype=np.float32) * 128)
+    values_b, aux_b = _actual_operand_nbytes(db, precision)
+    model_b = roofline.db_operand_nbytes(n, d, precision)
+    assert model_b["db_values"] == values_b
+    assert model_b["db_aux"] == aux_b
+    # and the full model's hbm term is exactly passes x those bytes
+    m = roofline.pallas_cost_model(
+        n=n, d=d, k=5, nq=64, precision=precision, kernel=kernel,
+        tile_n=128, block_q=32, device_kind="TPU v5e")
+    passes = m["terms"]["hbm"]["db_passes"]
+    assert passes == -(-64 // 32)  # query-major: one pass per block
+    assert m["terms"]["hbm"]["bytes"]["db_stream"] == passes * values_b
+    assert m["terms"]["hbm"]["bytes"]["db_aux"] == passes * aux_b
+
+
+def test_geometry_defaults_mirror_kernel_constants():
+    """The jax-free module mirrors the kernel's geometry defaults; a
+    drift here would silently mis-model every default-knob config."""
+    from knn_tpu.ops import pallas_knn as pk
+
+    assert roofline.TILE_N_DEFAULT == pk.TILE_N
+    assert roofline.BLOCK_Q_DEFAULT == pk.BLOCK_Q
+    assert roofline.BIN_W == pk.BIN_W
+    assert roofline.DIM_CHUNK == pk.DIM_CHUNK
+    n_bins, surv, out_w, bound_w = pk._geometry(pk.TILE_N)
+    assert surv == roofline.SURVIVORS_GROUPED_DEFAULT
+    # grouped default survivors=2 -> the out/bound widths the candidate
+    # output term assumes
+    assert out_w == surv * pk.BIN_W and bound_w == pk.BIN_W
+
+
+def test_bench_peak_table_is_a_view_over_roofline():
+    import bench
+
+    assert bench._PEAK_BY_KIND == roofline.bf16_peak_by_kind()
+    assert bench._PEAK_BY_KIND["TPU v5 lite"] == 197e12
+
+
+# --- ceilings bound measured reality -----------------------------------
+
+
+def test_interpret_mode_run_sits_under_the_cpu_ceiling(rng):
+    """roofline_pct <= 1 + tolerance against a real (interpret-mode,
+    CPU) run: even against the deliberately modest generic-CPU fallback
+    peaks, an interpreted kernel can never beat its own roofline."""
+    import time
+
+    from knn_tpu.ops.pallas_knn import knn_search_pallas
+
+    n, d, k, nq = 2048, 64, 5, 16
+    db = rng.random((n, d), dtype=np.float32) * 128
+    q = rng.random((nq, d), dtype=np.float32) * 128
+    import jax
+
+    d_, i_, _ = knn_search_pallas(q, db, k, tile_n=512)  # compile/warm
+    jax.block_until_ready((d_, i_))
+    t0 = time.perf_counter()
+    out = knn_search_pallas(q, db, k, tile_n=512)
+    jax.block_until_ready(out[:2])
+    qps = nq / (time.perf_counter() - t0)
+    model = roofline.pallas_cost_model(
+        n=n, d=d, k=k, nq=nq, tile_n=512, backend="cpu")
+    assert model["estimated"] is True
+    att = roofline.attribute(model, qps)
+    assert att["roofline_pct"] is not None
+    assert att["roofline_pct"] <= 1.05
+
+
+def test_r05_sift1m_curated_line_is_hbm_bound():
+    """Pinned regression: the r05 SIFT1M curated line (bf16x3, tiled,
+    query_major on a v5e) attributes its MFU gap to the db-streaming
+    term — hbm_bound, at a small measured fraction of the ceiling.
+    This is THE named gap ROADMAP item 1's kernel campaign attacks."""
+    path = os.path.join(REPO, "TPU_BENCH_r05.jsonl")
+    rec = None
+    for line in open(path):
+        cand = json.loads(line)
+        if cand.get("metric", "").startswith("knn_qps_sift1m"):
+            rec = cand
+            break
+    assert rec is not None, "r05 SIFT1M curated line missing"
+    block = roofline.block_for_bench_line(rec)
+    assert block is not None
+    assert block["estimated"] is False
+    assert block["bound_class"] == "hbm_bound"
+    # measured 24.2k device-phase q/s against a ~184k ceiling
+    assert 0.05 < block["roofline_pct"] < 0.3
+    assert roofline.validate_block(block) == []
+
+
+def test_bound_class_moves_with_the_config():
+    """The model names a different gap per campaign lever (the whole
+    point of attribution): int8 x streaming leaves the select as the
+    wall, db_major at single-chunk dims removes the streaming term,
+    and the XLA exact path is selection-bound."""
+    base = dict(n=1_000_000, d=128, k=100, nq=4096,
+                device_kind="TPU v5 lite", backend="tpu")
+    assert roofline.pallas_cost_model(**base)["bound_class"] == "hbm_bound"
+    m8 = roofline.pallas_cost_model(
+        precision="int8", kernel="streaming", **base)
+    assert m8["bound_class"] == "vpu_select_bound"
+    assert m8["ceiling_qps"] > roofline.pallas_cost_model(
+        **base)["ceiling_qps"]
+    mdb = roofline.pallas_cost_model(grid_order="db_major", **base)
+    assert mdb["bound_class"] == "mxu_bound"
+    assert mdb["terms"]["hbm"]["db_passes"] == 1
+    mx = roofline.xla_cost_model(
+        selector="exact", dtype="bfloat16", batch=512, **base)
+    assert mx["bound_class"] == "vpu_select_bound"
+    # approx runs two db passes — its hbm/mxu terms double
+    ma = roofline.xla_cost_model(
+        selector="approx", dtype="bfloat16", batch=512, **base)
+    assert ma["terms"]["mxu"]["flops_executed"] == \
+        2 * mx["terms"]["mxu"]["flops_executed"]
+
+
+def test_cpu_fallback_peaks_flag_estimated():
+    m = roofline.pallas_cost_model(
+        n=10_000, d=32, k=5, nq=64, device_kind="TPU v99", backend="tpu")
+    assert m["estimated"] is True  # unknown kind -> generic fallback
+    m2 = roofline.pallas_cost_model(
+        n=10_000, d=32, k=5, nq=64, device_kind="TPU v5e", backend="cpu")
+    assert m2["estimated"] is True  # cpu backend beats a known kind
+    line = {"metric": "knn_qps_x_n10000_d32_k5", "mode": "exact",
+            "value": 100.0, "backend": "cpu", "compute_dtype": "float32",
+            "batch": 32}
+    block = roofline.block_for_bench_line(line)
+    assert block["estimated"] is True
+    assert block["roofline_pct"] is not None
+
+
+# --- validation --------------------------------------------------------
+
+
+def test_validate_block_accepts_real_and_rejects_malformed():
+    good = roofline.attribute(
+        roofline.pallas_cost_model(n=1000, d=16, k=5, nq=8), 50.0)
+    assert roofline.validate_block(good) == []
+    assert roofline.validate_block("nope")  # not a dict
+    assert roofline.validate_block({})  # everything missing
+    bad = dict(good, bound_class="gpu_bound")
+    assert any("bound_class" in e for e in roofline.validate_block(bad))
+    bad = dict(good, ceiling_qps=-3)
+    assert any("ceiling_qps" in e for e in roofline.validate_block(bad))
+    bad = dict(good, terms={"hbm": {"time_s": -1}})
+    assert roofline.validate_block(bad)
+
+
+# --- tuning cache integration ------------------------------------------
+
+
+def test_cache_key_carries_roofline_token_and_pre_roofline_misses(
+        tmp_path):
+    """Satellite: the cache-key version bump — entries written before
+    the roofline fields existed (no |rl token) must miss and fall back
+    to defaults cleanly; current-token entries hit and surface their
+    persisted attribution through resolve_full."""
+    cache_path = str(tmp_path / "tune.json")
+    key = tuning.cache_key("cpu", 700, 16, 5, "l2", None)
+    assert f"|rl{roofline.MODEL_VERSION}|" in key
+    # a pre-roofline entry: same shape, no rl token (the old format)
+    pre = key.replace(f"|rl{roofline.MODEL_VERSION}", "")
+    cache = tuning.TuneCache(cache_path)
+    cache.put(pre, {"knobs": {**tuning.DEFAULT_KNOBS,
+                              "kernel": "streaming"}})
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "default"
+    assert knobs == tuning.DEFAULT_KNOBS
+    # a current entry carrying the winner's attribution DOES hit, and
+    # the verdict rides the resolve info + the /statusz store
+    block = roofline.attribute(
+        roofline.pallas_cost_model(n=700, d=16, k=5, nq=64), 500.0)
+    cache.put(key, {"knobs": dict(tuning.DEFAULT_KNOBS),
+                    "roofline_pct": block["roofline_pct"],
+                    "bound_class": block["bound_class"],
+                    "roofline": block})
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path,
+                                      device_kind="cpu")
+    assert info["source"] == "cache"
+    assert info["roofline_pct"] == block["roofline_pct"]
+    assert info["bound_class"] == block["bound_class"]
+    reports = roofline.last_reports()
+    label = roofline.config_label(700, 16, 5, device_kind="cpu")
+    assert label in reports
+    assert reports[label]["bound_class"] == block["bound_class"]
+
+
+def test_autotune_persists_winner_attribution(rng, tmp_path):
+    """The autotuner reports percent-of-roofline per candidate and
+    persists the winner's verdict in the cache entry."""
+    cache_path = str(tmp_path / "tune.json")
+    db = rng.random((768, 16), np.float32) * 128
+    q = rng.random((8, 16), np.float32) * 128
+    entry = tuning.autotune(db, q, 5, grid_level="quick", runs=1,
+                            cache_path=cache_path)
+    assert entry["bound_class"] in roofline.BOUND_CLASSES
+    assert 0 < entry["roofline_pct"] <= 1.05
+    assert roofline.validate_block(entry["roofline"]) == []
+    # every TIMED candidate got an attribution
+    timed = [lbl for lbl, ms in entry["timings_ms"].items()
+             if ms is not None]
+    for lbl in timed:
+        cand = entry["roofline_per_candidate"][lbl]
+        assert cand["bound_class"] in roofline.BOUND_CLASSES
+        assert cand["roofline_pct"] > 0
+    # the persisted entry round-trips the fields on a warm read
+    warm = tuning.autotune(db, q, 5, grid_level="quick", runs=1,
+                           cache_path=cache_path)
+    assert warm["cached"] is True
+    assert warm["roofline_pct"] == entry["roofline_pct"]
+
+
+# --- registry / statusz / obs-off --------------------------------------
+
+
+def test_publish_exports_metrics_and_statusz_renders():
+    block = roofline.attribute(
+        roofline.pallas_cost_model(n=1000, d=16, k=5, nq=8,
+                                   device_kind="TPU v5e",
+                                   backend="tpu"), 100.0)
+    roofline.publish("TPU v5e|n1000|d16|k5|l2|float32", block)
+    snap = obs.snapshot()
+    series = snap[mn.ROOFLINE_PCT]["series"]
+    assert series[0]["labels"]["config"] == \
+        "TPU v5e|n1000|d16|k5|l2|float32"
+    assert series[0]["value"] == block["roofline_pct"]
+    bounds = {(s["labels"]["class"], s["value"])
+              for s in snap[mn.ROOFLINE_BOUND]["series"]}
+    assert (block["bound_class"], 1.0) in bounds
+    assert obs.counter(mn.ROOFLINE_EVALUATIONS).get() == 1.0
+    text = obs.prometheus_text()
+    assert "knn_tpu_roofline_ceiling_qps" in text
+    rep = health.report()
+    assert "TPU v5e|n1000|d16|k5|l2|float32" in rep["roofline"]
+    rendered = health.render_text(rep)
+    assert "roofline TPU v5e|n1000|d16|k5|l2|float32" in rendered
+    assert block["bound_class"] in rendered
+
+
+def test_publish_is_a_noop_when_obs_disabled():
+    obs.reset(enabled=False)
+    try:
+        block = roofline.attribute(
+            roofline.pallas_cost_model(n=1000, d=16, k=5, nq=8), 10.0)
+        roofline.publish("cpu|n1000|d16|k5|l2|float32", block)
+        assert roofline.last_reports() == {}
+        assert "knn_tpu_roofline" not in obs.prometheus_text()
+    finally:
+        obs.reset()
+
+
+def test_last_reports_store_is_bounded():
+    block = roofline.attribute(
+        roofline.pallas_cost_model(n=1000, d=16, k=5, nq=8), 10.0)
+    for i in range(roofline._LAST_MAX + 4):
+        roofline.publish(f"cpu|n{i}|d16|k5|l2|float32", block)
+    assert len(roofline.last_reports()) == roofline._LAST_MAX
+    # the publish-once dedup survives the bounded store's eviction —
+    # otherwise a warm-cache hot path serving many configs would
+    # re-publish (and re-emit events) on every resolve
+    assert "cpu|n0|d16|k5|l2|float32" not in roofline.last_reports()
+    assert roofline.was_published("cpu|n0|d16|k5|l2|float32")
+
+
+def test_lint_skips_advisory_error_blocks_but_fails_malformed(tmp_path):
+    """scripts/perf_sentinel.py --lint: bench's advisory
+    {"error": ...} degradation blocks are a designed outcome (never a
+    CI failure); a structurally malformed block IS one."""
+    import subprocess
+    import sys
+
+    script = os.path.join(REPO, "scripts", "perf_sentinel.py")
+
+    def lint(lines):
+        (tmp_path / "TPU_BENCH_r01.jsonl").write_text(
+            "".join(json.dumps(r) + "\n" for r in lines))
+        return subprocess.run(
+            [sys.executable, script, "--lint", "--repo", str(tmp_path)],
+            capture_output=True, text=True, timeout=120)
+
+    good = roofline.attribute(
+        roofline.pallas_cost_model(n=1000, d=16, k=5, nq=8), 10.0)
+    base = {"metric": "knn_qps_x_n1000_d16_k5", "value": 10.0,
+            "backend": "tpu", "measured_round": 1,
+            "measured_at_commit": "abc"}
+    r = lint([dict(base, roofline=good),
+              dict(base, roofline={"error": "ValueError: model gap"})])
+    assert r.returncode == 0, r.stderr
+    assert "1 validated, 1 advisory-error blocks skipped" in r.stdout
+    r = lint([dict(base, roofline={"bound_class": "gpu_bound"})])
+    assert r.returncode == 1
+    assert "roofline block" in r.stderr
+
+
+# --- sentinel integration ----------------------------------------------
+
+
+def test_sentinel_judges_roofline_pct_as_a_curated_field():
+    """The sentinel's roofline_pct family: read off the top level or
+    out of the line's roofline block, judged like any curated field —
+    regressions are measured against the model's ceiling, not only
+    against raw-qps history."""
+    hist = []
+    for i, pct in enumerate((0.13, 0.131, 0.129, 0.132)):
+        hist.append({
+            "metric": "knn_qps_sift1m_n1000000_d128_k100",
+            "value": 6000.0 + i, "backend": "tpu",
+            "measured_round": i + 1, "measured_at_commit": f"c{i}",
+            # half hoisted, half block-only: both must enter
+            **({"roofline_pct": pct} if i % 2 else
+               {"roofline": {"roofline_pct": pct}}),
+        })
+    base = sentinel.build_baselines(hist)
+    key = "knn_qps_sift1m_n1000000_d128_k100|tpu|default"
+    assert "roofline_pct" in base[key]
+    fresh = {"metric": "knn_qps_sift1m_n1000000_d128_k100",
+             "backend": "tpu", "value": 6001.0,
+             "roofline": {"roofline_pct": 0.06}}
+    v = sentinel.verdict_for_line(fresh, baselines=base)
+    assert v["fields"]["roofline_pct"]["verdict"] == "regress"
+    fresh["roofline"]["roofline_pct"] = 0.13
+    v = sentinel.verdict_for_line(fresh, baselines=base)
+    assert v["fields"]["roofline_pct"]["verdict"] == "ok"
+
+
+# --- profiler ----------------------------------------------------------
+
+
+def test_profiler_gates(tmp_path, monkeypatch):
+    from knn_tpu.obs import profiler
+
+    # no env, no flag -> no capture, not even a directory
+    monkeypatch.delenv(profiler.PROFILE_ENV, raising=False)
+    with profiler.device_trace("sect") as tdir:
+        assert tdir is None
+    # env gate honors the obs switch
+    monkeypatch.setenv(profiler.PROFILE_ENV, str(tmp_path / "amb"))
+    obs.reset(enabled=False)
+    try:
+        assert profiler.profile_dir() is None
+        # ... but an explicit flag is an explicit request either way
+        with profiler.device_trace("m|ode x",
+                                   base_dir=str(tmp_path / "exp")) as td:
+            assert td == str(tmp_path / "exp" / "m_ode_x")
+            jnp.square(jnp.arange(4.0)).block_until_ready()
+        assert os.path.isdir(td)
+    finally:
+        obs.reset()
+    # obs back on: the env gate opens
+    with profiler.device_trace("tune") as td:
+        assert td == str(tmp_path / "amb" / "tune")
+        jnp.square(jnp.arange(4.0)).block_until_ready()
+    assert os.path.isdir(td)
+    events = [e for e in obs.get_event_log().recent()
+              if e.get("name") == "profiler.trace"]
+    assert events and events[-1]["trace_dir"] == td
+
+
+# --- cli ---------------------------------------------------------------
+
+
+def test_cli_roofline_subcommand(capsys):
+    from knn_tpu import cli
+
+    rc = cli.main(["roofline", "--n", "1000000", "--dim", "128",
+                   "--k", "100", "--device-kind", "TPU v5 lite",
+                   "--qps", "24199.3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "hbm_bound" in out
+    tail = json.loads(out.strip().splitlines()[-1])
+    assert tail["bound_class"] == "hbm_bound"
+    assert tail["roofline_pct"] == pytest.approx(0.131, abs=0.01)
+    rc = cli.main(["roofline", "--n", "100000", "--dim", "960",
+                   "--k", "10", "--selector", "approx",
+                   "--dtype", "bfloat16", "--batch", "512", "--json"])
+    assert rc == 0
+    block = json.loads(capsys.readouterr().out)
+    assert roofline.validate_block(block) == []
