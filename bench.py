@@ -178,6 +178,18 @@ try:
     #: obs_overhead_pct = (qps_off - qps_on) / qps_off * 100.  Opt-in:
     #: the double replay costs a second trace of chip time.
     OBS_OVERHEAD = os.environ.get("KNN_BENCH_OBS_OVERHEAD", "0") == "1"
+    #: ``knee`` mode (knn_tpu.loadgen): open-loop stepped-rate sweep
+    #: through the micro-batching queue, locating the
+    #: latency-vs-throughput knee.  Opt-in via KNN_BENCH_MODES=..,knee
+    #: (each rate step costs KNEE_STEP_S wall seconds).  Unset
+    #: KNEE_RATES = a ladder of KNEE_FRACTIONS x a measured closed-loop
+    #: anchor rate.
+    KNEE_RATES = [float(x) for x in os.environ.get(
+        "KNN_BENCH_KNEE_RATES", "").split(",") if x.strip()]
+    KNEE_STEP_S = float(os.environ.get("KNN_BENCH_KNEE_STEP_S", "1.0"))
+    KNEE_SLO_MS = float(os.environ.get("KNN_BENCH_KNEE_SLO_MS", "100"))
+    KNEE_TENANTS = os.environ.get("KNN_BENCH_KNEE_TENANTS", "default:1")
+    KNEE_SEED = _env_int("KNN_BENCH_KNEE_SEED", 0)
 except Exception as _e:  # bad env: the one-JSON-line contract still holds
     print(json.dumps({
         "metric": "knn_qps_config", "value": None, "unit": "queries/s",
@@ -820,6 +832,59 @@ def main() -> None:
             "tuning": report.get("tuning"),
         }
 
+    def sweep_knee():
+        """Open-loop stepped-rate sweep (knn_tpu.loadgen) through the
+        micro-batching queue: the latency-vs-throughput knee as a
+        curated artifact (rate steps, admitted p50/p95/p99, shed
+        fraction, detected knee q/s).  Admission control participates
+        when the KNN_TPU_ADMISSION_* env knobs are set — the brownout
+        configuration — and stays off otherwise, measuring the raw
+        engine.  Request rates are REQUESTS/s (mixed batch sizes, like
+        real traffic), anchored on a short closed-loop probe when
+        KNN_BENCH_KNEE_RATES is unset."""
+        from knn_tpu import loadgen
+        from knn_tpu.serving.admission import AdmissionConfig
+        from knn_tpu.serving.engine import ServingEngine
+        from knn_tpu.serving.queue import QueryQueue
+
+        min_bucket = SERVING_MIN_BUCKET or max(1, BATCH // 32)
+        eng = ServingEngine(prog, min_bucket=min_bucket, max_bucket=BATCH)
+        eng.warmup()
+        admission = AdmissionConfig.from_env()
+        tenants = tuple(
+            loadgen.TenantSpec(t.name, weight=t.weight,
+                               priority=t.priority,
+                               batch_sizes=(1, 2, 4, 8))
+            for t in loadgen.parse_tenants(KNEE_TENANTS))
+        base = loadgen.WorkloadSpec(
+            rate_qps=1.0, duration_s=KNEE_STEP_S, seed=KNEE_SEED,
+            tenants=tenants)
+        rates = KNEE_RATES
+        anchor = None
+        if not rates:
+            # closed-loop anchor probe (admission-free queue): the
+            # default ladder brackets the knee around it
+            with QueryQueue(eng, max_wait_ms=2.0) as q0:
+                anchor = loadgen.closed_loop_anchor(q0, queries)
+            rates = loadgen.rates_around(anchor)
+
+        def make_queue():
+            return QueryQueue(eng, max_wait_ms=2.0, admission=admission)
+
+        block = loadgen.knee_sweep(
+            make_queue, base, rates, queries=queries,
+            slo_p99_ms=KNEE_SLO_MS)
+        return {
+            "loadgen_knee": block,
+            "knee_qps": block["knee_qps"],
+            "slo_p99_ms": KNEE_SLO_MS,
+            "anchor_req_qps": (round(anchor, 2)
+                               if anchor is not None else None),
+            "admission_enabled": admission is not None,
+            "rates": [float(r) for r in rates],
+            "tenants": KNEE_TENANTS,
+        }
+
     def roofline_for_mode(mode, entry):
         """The selector's ``roofline`` block (knn_tpu.obs.roofline):
         analytic ceiling q/s + bound class for the config this mode
@@ -1109,6 +1174,15 @@ def main() -> None:
                         "error": f"{type(e).__name__}: {e}"}
             results[mode] = entry
             continue
+        if mode == "knee":
+            # open-loop saturation sweep: like serving, a traffic-shape
+            # measurement, never a headline-number competitor
+            try:
+                entry = sweep_knee()
+            except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+                entry = {"error": f"{type(e).__name__}: {e}"}
+            results[mode] = entry
+            continue
         try:
             fn = sweeps[mode]
             _vlog(f"mode {mode}: recall check + warm ...")
@@ -1306,6 +1380,14 @@ def main() -> None:
                 results["serving"]["obs_overhead_pct"]}
                if "obs_overhead_pct" in results["serving"] else {}),
         } if results.get("serving", {}).get("sustained_qps") else {}),
+        # the measured latency-vs-throughput knee (opt-in knee mode):
+        # block + hoisted knee_qps so the artifact refresher validates
+        # it and the sentinel baselines it like any curated field
+        **({
+            "loadgen_knee": results["knee"]["loadgen_knee"],
+            **({"knee_qps": results["knee"]["knee_qps"]}
+               if results["knee"].get("knee_qps") is not None else {}),
+        } if results.get("knee", {}).get("loadgen_knee") else {}),
         **(gate or {}),
         "recall_at_k": results[best].get("recall_at_k"),
         **recall_flag,

@@ -51,6 +51,20 @@ resource that caps this config — with ``--qps`` it also prints the
 measured percent of roofline.  The planning companion of the bench's
 per-line ``roofline`` blocks: answer "what would int8 x streaming be
 bounded by at this shape?" before burning chip time on it.
+
+    python -m knn_tpu.cli loadgen --synthetic 500 --slo-p99-ms 20
+    python -m knn_tpu.cli loadgen --n 100000 --dim 64 --rates 50,100,200 \\
+        --max-depth 64 --shed --deadline-ms 250 --tenants gold:3,free:1
+
+runs the open-loop load harness (knn_tpu.loadgen): a seeded
+Poisson/bursty multi-tenant workload stepped through increasing rates
+against the synthetic single-server model (jax-free) or a freshly
+built serving stack, printing the latency-vs-throughput knee artifact
+(rate steps, admitted p50/p95/p99, shed fraction, detected knee q/s)
+as one trailing JSON line — the same block bench.py's ``knee`` mode
+embeds and ``refresh_bench_artifacts.py`` curates.  Admission flags
+(``--max-depth``/``--shed``/``--quota``) exercise the brownout
+controls (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -432,6 +446,219 @@ def run_roofline(args: argparse.Namespace) -> int:
     return 0
 
 
+def build_loadgen_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu loadgen",
+        description="Open-loop load generation + knee sweep "
+        "(knn_tpu.loadgen): drive a serving target with a seeded "
+        "Poisson/bursty/replayed multi-tenant workload through a "
+        "stepped-rate sweep, and print the latency-vs-throughput knee "
+        "artifact (rate steps, admitted p50/p95/p99, shed fraction, "
+        "detected knee q/s) as one trailing JSON line.  "
+        "--synthetic CAPACITY runs against the built-in single-server "
+        "model (jax-free — validates the harness and admission policy "
+        "without hardware); otherwise a synthetic-data ShardedKNN + "
+        "ServingEngine + QueryQueue is built at --n/--dim/--k.  "
+        "Admission control: --max-depth/--shed/--quota/--deadline-ms "
+        "(or the KNN_TPU_ADMISSION_* env knobs).")
+    p.add_argument("--synthetic", type=float, default=None,
+                   metavar="QPS", help="drive the jax-free synthetic "
+                   "target with this service capacity instead of a "
+                   "real engine")
+    p.add_argument("--n", type=int, default=100_000, help="database rows")
+    p.add_argument("--dim", type=int, default=64, help="feature dim")
+    p.add_argument("--k", type=int, default=10, help="neighbor count")
+    p.add_argument("--metric", default="l2",
+                   choices=("l2", "sql2", "euclidean", "cosine"))
+    p.add_argument("--rates", default=None, metavar="R1,R2,...",
+                   help="offered request rates (q/s) to step through; "
+                   "unset = a ladder bracketing a measured closed-loop "
+                   "anchor (real target) or the synthetic capacity")
+    p.add_argument("--duration", type=float, default=1.0, metavar="S",
+                   help="seconds per rate step")
+    p.add_argument("--slo-p99-ms", type=float, default=100.0,
+                   help="admitted-request p99 bound defining the knee")
+    p.add_argument("--tenants", default="default:1",
+                   help="tenant mix: name[:weight[:priority]],...")
+    p.add_argument("--batch-sizes", default="1,2,4,8",
+                   help="request row counts, drawn uniformly per request")
+    p.add_argument("--arrival", default="poisson",
+                   choices=("poisson", "onoff"),
+                   help="arrival process (bursty on/off via --on-s/"
+                   "--off-s/--burst)")
+    p.add_argument("--on-s", type=float, default=0.25)
+    p.add_argument("--off-s", type=float, default=0.25)
+    p.add_argument("--burst", type=float, default=4.0,
+                   help="on-phase rate multiplier for --arrival onoff")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request deadline applied to every tenant; "
+                   "implies deadline-aware shedding (--shed), so the "
+                   "deadlines are enforced, not just recorded")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="micro-batching deadline of the driven queue")
+    p.add_argument("--max-depth", type=int, default=None,
+                   help="admission: bounded queue depth (explicit "
+                   "rejection past it)")
+    p.add_argument("--shed", action="store_true",
+                   help="admission: deadline-aware load shedding")
+    p.add_argument("--quota", action="append", default=[],
+                   metavar="TENANT:RATE[:BURST]",
+                   help="admission: per-tenant token-bucket quota "
+                   "(repeatable)")
+    p.add_argument("--replay", default=None, metavar="PATH",
+                   help="replay a recorded JSONL trace instead of "
+                   "generating arrivals (single run, no sweep)")
+    p.add_argument("--save-trace", default=None, metavar="PATH",
+                   help="record the generated schedule (first rate "
+                   "step) to this JSONL file for later --replay")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw artifact JSON only")
+    p.add_argument("--cpu-devices", type=int, default=None, metavar="N",
+                   help="force an N-virtual-device CPU backend")
+    return p
+
+
+def run_loadgen(args: argparse.Namespace) -> int:
+    """The `loadgen` subcommand: a knee sweep (or single replay run)
+    against the synthetic model or a freshly built serving stack,
+    printing a human summary plus ONE trailing JSON line (the knee
+    artifact — the same block bench.py's knee mode embeds)."""
+    import json
+
+    import numpy as np
+
+    from knn_tpu import loadgen
+    from knn_tpu.serving.admission import AdmissionConfig
+
+    tenants = tuple(
+        loadgen.TenantSpec(
+            t.name, weight=t.weight, priority=t.priority,
+            batch_sizes=tuple(int(b) for b in
+                              args.batch_sizes.split(",") if b.strip()),
+            deadline_ms=args.deadline_ms)
+        for t in loadgen.parse_tenants(args.tenants))
+    from knn_tpu.serving.admission import parse_quotas
+
+    try:
+        quotas = parse_quotas(",".join(args.quota))
+    except ValueError as e:
+        print(f"--quota: {e}", file=sys.stderr)
+        return 1
+    # only NONZERO tenant levels become a priority table — an
+    # all-zero dict would defeat the queue's FIFO fast path and
+    # spuriously trip the synthetic-limitations warning below
+    priorities = {t.name: t.priority for t in tenants if t.priority}
+    if (args.max_depth is not None or args.shed or quotas or priorities
+            or args.deadline_ms is not None):
+        # any of these flags (nonzero tenant levels included —
+        # priorities only reorder through an admission-enabled queue)
+        # opts into admission.  --deadline-ms implies shedding:
+        # attaching deadlines nobody enforces would silently report
+        # shed=0 as "all deadlines met"
+        admission = AdmissionConfig(
+            max_depth=args.max_depth,
+            shed=args.shed or args.deadline_ms is not None,
+            quotas=quotas, priorities=priorities)
+    else:
+        admission = AdmissionConfig.from_env()
+
+    # parse --rates up front so the anchor-probe gate and the ladder
+    # fallback judge the SAME thing (the PARSED list: '--rates ,' is a
+    # truthy string but an empty ladder)
+    rates_given = ([float(r) for r in args.rates.split(",") if r.strip()]
+                   if args.rates else None) or None
+
+    dim = args.dim
+    if args.synthetic is not None:
+        if admission is not None and (admission.quotas
+                                      or admission.priorities):
+            # the single-server model can mimic depth/shed only; a
+            # silent no-op would read as "quotas do nothing"
+            print("warning: --synthetic models max-depth and deadline "
+                  "shedding only — quotas and priorities are ignored "
+                  "(use a real engine to exercise them)",
+                  file=sys.stderr)
+
+        def make_target():
+            return loadgen.SyntheticTarget(
+                args.synthetic,
+                max_depth=None if admission is None
+                else admission.max_depth,
+                shed_deadlines=admission.shed if admission else False)
+        anchor = args.synthetic
+        pool = np.zeros((max(64, *(max(t.batch_sizes) for t in tenants)),
+                         dim), np.float32)
+    else:
+        from knn_tpu.parallel.mesh import make_mesh
+        from knn_tpu.parallel.sharded import ShardedKNN
+        from knn_tpu.serving.engine import ServingEngine
+        from knn_tpu.serving.queue import QueryQueue
+
+        rng = np.random.default_rng(args.seed)
+        db = (rng.random((args.n, dim)) * 128.0).astype(np.float32)
+        pool = (rng.random((4096, dim)) * 128.0).astype(np.float32)
+        prog = ShardedKNN(db, mesh=make_mesh(), k=args.k,
+                          metric=args.metric)
+        engine = ServingEngine(prog)
+        print("warming serving engine ...", file=sys.stderr)
+        engine.warmup()
+
+        def make_target():
+            return QueryQueue(engine, max_wait_ms=args.max_wait_ms,
+                              admission=admission)
+
+        anchor = None
+        if rates_given is None and not args.replay:
+            # closed-loop anchor probe through an ADMISSION-FREE
+            # queue, only when the rate ladder actually needs it
+            # (the burst would trip a tight --max-depth, and explicit
+            # --rates/--replay would discard the result)
+            with QueryQueue(engine, max_wait_ms=args.max_wait_ms) as q0:
+                anchor = loadgen.closed_loop_anchor(q0, pool)
+
+    base = loadgen.WorkloadSpec(
+        rate_qps=1.0, duration_s=args.duration, seed=args.seed,
+        arrival=args.arrival, tenants=tenants, on_s=args.on_s,
+        off_s=args.off_s, burst=args.burst)
+    if args.replay:
+        reqs = loadgen.load_trace(args.replay)
+        target = make_target()
+        try:
+            rep = loadgen.run_workload(target, reqs, queries=pool)
+        finally:
+            close = getattr(target, "close", None)
+            if callable(close):
+                close()
+        if not args.json:
+            lat = rep.get("latency_ms") or {}
+            print(f"replayed {rep['offered']} requests: ok={rep['ok']} "
+                  f"rejected={rep['rejected']} shed={rep['shed']} "
+                  f"p99={lat.get('p99')} ms "
+                  f"achieved={rep['achieved_qps']} q/s")
+        print(json.dumps(rep))
+        return 0
+    rates = rates_given or loadgen.rates_around(anchor)
+    if args.save_trace:
+        loadgen.save_trace(loadgen.generate(base.at_rate(rates[0])),
+                           args.save_trace)
+        print(f"trace saved: {args.save_trace}", file=sys.stderr)
+    block = loadgen.knee_sweep(make_target, base, rates, queries=pool,
+                               slo_p99_ms=args.slo_p99_ms)
+    if not args.json:
+        for s in block["rate_steps"]:
+            print(f"rate {s['rate_qps']:>9.2f} q/s: ok={s['ok']:>5} "
+                  f"rejected={s['rejected']:>4} shed={s['shed']:>4} "
+                  f"p99={s['admitted_p99_ms']} ms "
+                  f"achieved={s['achieved_qps']} q/s "
+                  f"{'WITHIN' if s['within_slo'] else 'OVER'} SLO")
+        print(f"knee: {block['knee_qps']} q/s sustained "
+              f"(offered {block['knee_rate_qps']} q/s) at p99 <= "
+              f"{block['slo_p99_ms']} ms")
+    print(json.dumps(block))
+    return 0
+
+
 def args_to_config(args: argparse.Namespace) -> JobConfig:
     return JobConfig(
         train_file=args.train,
@@ -479,6 +706,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_doctor(build_doctor_parser().parse_args(argv[1:]))
     if argv[:1] == ["roofline"]:
         return run_roofline(build_roofline_parser().parse_args(argv[1:]))
+    if argv[:1] == ["loadgen"]:
+        largs = build_loadgen_parser().parse_args(argv[1:])
+        if largs.cpu_devices:
+            from knn_tpu.utils.compat import request_cpu_devices
+
+            request_cpu_devices(largs.cpu_devices)
+        return run_loadgen(largs)
     args = build_parser().parse_args(argv)
     if args.cpu_devices:
         # Must precede backend initialization; env vars are too late when a

@@ -1,0 +1,105 @@
+"""A jax-free synthetic serving target with a known capacity — the
+device-free test double for the loadgen harness itself.
+
+The knee sweep's correctness (does it find the latency-vs-throughput
+knee?) must be testable without a device, a mesh, or XLA: this target
+is a single-server queue with a CONFIGURED capacity, so its knee is
+known by construction — latency stays near ``base_latency_ms`` below
+``capacity_qps`` and grows without bound above it (the queueing-theory
+shape the real engine shows at saturation).  A knee detector that
+cannot find THIS knee cannot be trusted on hardware.
+
+``submit`` matches the :class:`~knn_tpu.serving.queue.QueryQueue`
+surface the driver targets (``tenant``/``deadline_ms``/``priority``
+kwargs, Future result, ``dispatch_t`` stamped at service start), and
+the optional ``max_depth``/``shed_deadlines`` knobs mimic admission so
+shed accounting can be exercised end-to-end without hardware.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+from knn_tpu.serving.admission import DeadlineError, QueueFullError
+
+
+class SyntheticTarget:
+    """Single-server FIFO queue: service time ``1/capacity_qps`` per
+    request, one worker thread — so an unloaded request's latency is
+    one service time and the knee sits at ``capacity_qps`` by
+    construction.  Close it (or use as a context manager) to join the
+    worker."""
+
+    def __init__(self, capacity_qps: float, *,
+                 max_depth: Optional[int] = None,
+                 shed_deadlines: bool = False):
+        if capacity_qps <= 0:
+            raise ValueError(
+                f"capacity_qps must be > 0, got {capacity_qps}")
+        self.capacity_qps = float(capacity_qps)
+        self.max_depth = max_depth
+        self.shed_deadlines = bool(shed_deadlines)
+        self._q: _queue.Queue = _queue.Queue()
+        self._depth = 0  # tracked explicitly: Queue.qsize is advisory
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._serve, name="synthetic-target", daemon=True)
+        self._worker.start()
+
+    def submit(self, queries, *, tenant: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[int] = None) -> Future:
+        now = time.monotonic()
+        with self._lock:
+            if self.max_depth is not None and self._depth >= self.max_depth:
+                raise QueueFullError(
+                    f"synthetic queue at max_depth {self.max_depth}",
+                    tenant=tenant)
+            self._depth += 1
+        fut: Future = Future()
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        self._q.put((fut, tenant, deadline))
+        return fut
+
+    def _serve(self) -> None:
+        service_s = 1.0 / self.capacity_qps
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            fut, tenant, deadline = item
+            now = time.monotonic()
+            if (self.shed_deadlines and deadline is not None
+                    and now > deadline):
+                if not fut.cancelled():
+                    fut.set_exception(DeadlineError(
+                        "deadline expired in synthetic queue",
+                        tenant=tenant, reason="expired"))
+                with self._lock:
+                    self._depth -= 1
+                continue
+            fut.dispatch_t = now
+            time.sleep(service_s)
+            if not fut.cancelled():
+                fut.set_result(None)
+            # retire AFTER service, matching the real queue's
+            # outstanding (queued + in flight) depth semantics — a
+            # dequeue-time decrement would admit one extra request at
+            # every depth bound
+            with self._lock:
+                self._depth -= 1
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

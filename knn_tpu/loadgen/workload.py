@@ -1,0 +1,264 @@
+"""Workload specification and deterministic arrival generation.
+
+The serving stack's design goals (no idle workers, bounded queues,
+graceful brownout) only show up under production-shaped traffic, and a
+closed-loop microbench can never produce it: a closed loop waits for
+each completion before offering the next request, so the offered rate
+collapses to whatever the server sustains and the knee is unobservable
+by construction.  This module generates **open-loop** request schedules
+— arrival times fixed in advance by the arrival process, independent of
+how the server is doing — as plain data, so the same trace can be
+generated, saved, replayed, and rate-scaled deterministically.
+
+A :class:`WorkloadSpec` describes the mix: an aggregate request rate,
+an arrival process (``poisson`` — memoryless open-loop; ``onoff`` —
+bursty square-wave with a ``burst``-multiplied on-phase; ``replay`` —
+a recorded JSONL trace), and a multi-tenant mix of
+:class:`TenantSpec` entries (weights, request shapes, deadlines,
+priorities).  :func:`generate` turns it into a list of
+:class:`Request` values under a fixed seed — two calls with the same
+spec are identical element for element (pinned in
+tests/test_loadgen.py), which is what makes a measured knee
+reproducible and a brownout test deterministic.
+
+Everything here is jax-free (numpy only): workload generation must be
+runnable on the box that writes the trace, not only the one with the
+accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: arrival processes generate() understands
+ARRIVALS = ("poisson", "onoff", "replay")
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's slice of the mix: its share of the aggregate rate
+    (``weight``), the request shapes it sends (``batch_sizes``, drawn
+    uniformly per request), and the admission-relevant tags that ride
+    each request (deadline, priority, precision)."""
+
+    name: str
+    weight: float = 1.0
+    #: request row counts, drawn uniformly per request
+    batch_sizes: Tuple[int, ...] = (1, 2, 4, 8)
+    #: neighbor count the tenant asks for (None = server default)
+    k: Optional[int] = None
+    #: distance metric tag (None = server default)
+    metric: Optional[str] = None
+    #: coarse-pass precision tag ("f32" / "int8"; None = server default)
+    precision: Optional[str] = None
+    #: per-request deadline (ms from arrival; None = no deadline)
+    deadline_ms: Optional[float] = None
+    #: dispatch priority (lower first; admission aging keeps it
+    #: starvation-safe)
+    priority: int = 0
+
+    def validate(self) -> None:
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be > 0, got "
+                f"{self.weight}")
+        if not self.batch_sizes or any(b < 1 for b in self.batch_sizes):
+            raise ValueError(
+                f"tenant {self.name!r}: batch_sizes must be >= 1, got "
+                f"{self.batch_sizes}")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: deadline_ms must be > 0, got "
+                f"{self.deadline_ms}")
+
+
+@dataclass(frozen=True)
+class Request:
+    """One scheduled request: WHEN it arrives (``t``, seconds from
+    trace start — fixed in advance, the open-loop property), WHO sends
+    it, and its shape/deadline/priority tags."""
+
+    tenant: str
+    t: float
+    rows: int
+    k: Optional[int] = None
+    metric: Optional[str] = None
+    precision: Optional[str] = None
+    deadline_ms: Optional[float] = None
+    priority: int = 0
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    """The full mix: aggregate ``rate_qps`` (requests/s, not rows/s)
+    over ``duration_s``, split across ``tenants`` by weight, arriving
+    by ``arrival``.  ``onoff`` alternates ``on_s`` seconds at
+    ``rate_qps * burst`` with ``off_s`` seconds of silence (the bursty
+    pattern admission control exists for); ``replay`` reads the JSONL
+    trace at ``trace_path`` verbatim (rate/duration/tenants ignored)."""
+
+    rate_qps: float = 100.0
+    duration_s: float = 1.0
+    seed: int = 0
+    arrival: str = "poisson"
+    tenants: Tuple[TenantSpec, ...] = field(
+        default_factory=lambda: (TenantSpec("default"),))
+    on_s: float = 0.25
+    off_s: float = 0.25
+    burst: float = 4.0
+    trace_path: Optional[str] = None
+
+    def validate(self) -> None:
+        if self.arrival not in ARRIVALS:
+            raise ValueError(
+                f"arrival must be one of {ARRIVALS}, got {self.arrival!r}")
+        if self.arrival == "replay":
+            if not self.trace_path:
+                raise ValueError("arrival='replay' needs trace_path")
+            return
+        if self.rate_qps <= 0:
+            raise ValueError(f"rate_qps must be > 0, got {self.rate_qps}")
+        if self.duration_s <= 0:
+            raise ValueError(
+                f"duration_s must be > 0, got {self.duration_s}")
+        if not self.tenants:
+            raise ValueError("at least one tenant required")
+        seen = set()
+        for t in self.tenants:
+            if t.name in seen:
+                raise ValueError(f"duplicate tenant name {t.name!r}")
+            seen.add(t.name)
+            t.validate()
+        if self.arrival == "onoff":
+            if self.on_s <= 0 or self.off_s < 0:
+                raise ValueError(
+                    f"onoff needs on_s > 0 and off_s >= 0, got "
+                    f"on_s={self.on_s} off_s={self.off_s}")
+            if self.burst <= 0:
+                raise ValueError(f"burst must be > 0, got {self.burst}")
+
+    def at_rate(self, rate_qps: float) -> "WorkloadSpec":
+        """The same mix at a different aggregate rate — the knee
+        sweep's step generator (same seed: the step traces differ only
+        by arrival spacing, never by mix)."""
+        return WorkloadSpec(
+            rate_qps=float(rate_qps), duration_s=self.duration_s,
+            seed=self.seed, arrival=self.arrival, tenants=self.tenants,
+            on_s=self.on_s, off_s=self.off_s, burst=self.burst,
+            trace_path=self.trace_path)
+
+
+def _arrival_times(spec: WorkloadSpec, rng: np.random.Generator
+                   ) -> List[float]:
+    """Arrival offsets (seconds, ascending) for the configured process.
+    Poisson: exponential gaps at ``rate_qps``.  On/off: exponential
+    gaps at ``rate_qps * burst`` inside on-windows, silence in
+    off-windows (arrivals landing in an off-window are pushed to the
+    next on-edge — the synchronized-burst shape that stresses
+    admission hardest)."""
+    out: List[float] = []
+    if spec.arrival == "poisson":
+        t = 0.0
+        while True:
+            t += float(rng.exponential(1.0 / spec.rate_qps))
+            if t >= spec.duration_s:
+                break
+            out.append(t)
+        return out
+    # onoff
+    period = spec.on_s + spec.off_s
+    rate_on = spec.rate_qps * spec.burst
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rate_on))
+        # skip the off part of whichever period t landed in — LOOPED:
+        # a re-drawn gap can itself overshoot the next on-window (at
+        # low rates e^{-rate_on*on_s} is not small), and an arrival in
+        # a silence window would break the square-wave invariant the
+        # admission tests lean on
+        k, phase = divmod(t, period)
+        while phase > spec.on_s:
+            t = (k + 1) * period + float(rng.exponential(1.0 / rate_on))
+            k, phase = divmod(t, period)
+        if t >= spec.duration_s:
+            break
+        out.append(t)
+    return out
+
+
+def generate(spec: WorkloadSpec) -> List[Request]:
+    """The deterministic request schedule for ``spec``: same spec ->
+    identical list, element for element.  ``replay`` loads the trace
+    verbatim (already a schedule)."""
+    spec.validate()
+    if spec.arrival == "replay":
+        return load_trace(spec.trace_path)
+    rng = np.random.default_rng(spec.seed)
+    times = _arrival_times(spec, rng)
+    weights = np.asarray([t.weight for t in spec.tenants], np.float64)
+    weights = weights / weights.sum()
+    picks = rng.choice(len(spec.tenants), size=len(times), p=weights)
+    out: List[Request] = []
+    for t, pick in zip(times, picks):
+        ten = spec.tenants[int(pick)]
+        rows = int(ten.batch_sizes[int(
+            rng.integers(0, len(ten.batch_sizes)))])
+        out.append(Request(
+            tenant=ten.name, t=round(float(t), 6), rows=rows, k=ten.k,
+            metric=ten.metric, precision=ten.precision,
+            deadline_ms=ten.deadline_ms, priority=ten.priority))
+    return out
+
+
+# -- trace persistence (JSONL: one request per line) ----------------------
+def save_trace(requests: Sequence[Request], path: str) -> None:
+    """One JSON object per line; :func:`load_trace` round-trips it
+    exactly (pinned in tests/test_loadgen.py)."""
+    with open(path, "w") as f:
+        for r in requests:
+            f.write(json.dumps(asdict(r), sort_keys=True) + "\n")
+
+
+def load_trace(path: str) -> List[Request]:
+    out: List[Request] = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: not JSON: {e}") from e
+            try:
+                out.append(Request(**rec))
+            except TypeError as e:
+                raise ValueError(
+                    f"{path}:{ln}: not a request record: {e}") from e
+    out.sort(key=lambda r: r.t)
+    return out
+
+
+def parse_tenants(text: str) -> Tuple[TenantSpec, ...]:
+    """CLI shorthand ``name[:weight[:priority]],...`` -> tenant specs
+    (e.g. ``gold:3:0,free:1:2``)."""
+    out = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) > 3:
+            raise ValueError(
+                f"tenant spec {part!r}: expected name[:weight[:priority]]")
+        out.append(TenantSpec(
+            name=bits[0],
+            weight=float(bits[1]) if len(bits) > 1 else 1.0,
+            priority=int(bits[2]) if len(bits) > 2 else 0))
+    if not out:
+        raise ValueError(f"no tenants in {text!r}")
+    return tuple(out)

@@ -173,7 +173,8 @@ def _queue_status(q) -> dict:
     # a status probe must never contend for the dispatch condvar
     depth_req = len(getattr(q, "_pending", ()))
     depth_rows = int(getattr(q, "_pending_rows", 0))
-    return {
+    ctrl = getattr(q, "_ctrl", None)
+    out = {
         "op": getattr(q, "op", None),
         "closed": bool(getattr(q, "_closed", False)),
         "max_wait_ms": round(getattr(q, "max_wait_s", 0.0) * 1e3, 3),
@@ -182,9 +183,18 @@ def _queue_status(q) -> dict:
         "depth_rows": depth_rows,
         "rows_utilization": (round(depth_rows / q.max_rows, 4)
                              if getattr(q, "max_rows", 0) else None),
+        # outstanding = queued + in flight: what admission's depth
+        # bound and wait estimate actually judge
+        "outstanding_requests": int(getattr(q, "_out_req", 0)),
         "batcher_alive": q._batcher_t.is_alive(),
         "completer_alive": q._completer_t.is_alive(),
     }
+    if ctrl is not None:
+        try:
+            out["admission"] = ctrl.stats()
+        except Exception as ex:  # noqa: BLE001 — probe must not die on it
+            out["admission"] = {"error": f"{type(ex).__name__}: {ex}"}
+    return out
 
 
 def _tune_cache_status() -> dict:
@@ -315,20 +325,36 @@ def render_text(rep: dict) -> str:
                      f"({r.get('bound_class')}){est}")
     breaches = rep.get("active_breaches", [])
     lines.append(f"slo breaches: {', '.join(breaches) if breaches else 'none'}")
-    for o_name, o in (rep.get("slo", {}).get("objectives", {}) or {}).items():
+    def _slo_line(name, o, indent="  "):
         state = "BREACHED" if o.get("breached") else "ok"
         if o.get("kind") == "quantile":
-            lines.append(
-                f"  slo {o_name}: {state} {o.get('quantile')}="
-                f"{o.get('value_s')}s (threshold {o.get('threshold_s')}s, "
-                f"window {o.get('window_samples')} samples / "
-                f"{o.get('window_span_s')}s)")
-        else:
-            burns = {w: d.get("burn_rate")
-                     for w, d in (o.get("windows") or {}).items()}
-            lines.append(
-                f"  slo {o_name}: {state} burn={burns} "
+            return (f"{indent}slo {name}: {state} {o.get('quantile')}="
+                    f"{o.get('value_s')}s (threshold "
+                    f"{o.get('threshold_s')}s, window "
+                    f"{o.get('window_samples')} samples / "
+                    f"{o.get('window_span_s')}s)")
+        burns = {w: d.get("burn_rate")
+                 for w, d in (o.get("windows") or {}).items()}
+        return (f"{indent}slo {name}: {state} burn={burns} "
                 f"(target {o.get('target')})")
+
+    for o_name, o in (rep.get("slo", {}).get("objectives", {}) or {}).items():
+        if o.get("group_by") is not None:
+            # grouped objective: one line per label value (the
+            # per-tenant drill-down), a summary line when idle
+            groups = o.get("groups") or {}
+            if not groups:
+                lines.append(f"  slo {o_name}: no {o.get('group_by')} "
+                             f"traffic")
+                continue
+            breached = o.get("breached") or []
+            lines.append(f"  slo {o_name} (per {o.get('group_by')}): "
+                         f"{len(breached)}/{len(groups)} breached")
+            for gval, gentry in sorted(groups.items()):
+                lines.append(_slo_line(f"{o_name}:{gval}", gentry,
+                                       indent="    "))
+            continue
+        lines.append(_slo_line(o_name, o))
     alerts = rep.get("alerts", [])
     if alerts:
         lines.append(f"last {len(alerts)} alert event(s):")

@@ -34,6 +34,17 @@ QUEUE_ERRORS = "knn_tpu_queue_errors_total"
 QUEUE_WAIT = "knn_tpu_queue_wait_seconds"
 QUEUE_REQUEST_LATENCY = "knn_tpu_queue_request_latency_seconds"
 
+# --- admission control (knn_tpu.serving.admission / queue) -------------
+ADMISSION_ADMITTED = "knn_tpu_admission_admitted_total"
+ADMISSION_REJECTED = "knn_tpu_admission_rejected_total"
+ADMISSION_SHED = "knn_tpu_admission_shed_total"
+ADMISSION_WAIT_ESTIMATE = "knn_tpu_admission_queue_wait_estimate_seconds"
+
+# --- per-tenant serving attribution (knn_tpu.serving) ------------------
+TENANT_REQUESTS = "knn_tpu_tenant_requests_total"
+TENANT_ERRORS = "knn_tpu_tenant_errors_total"
+TENANT_REQUEST_LATENCY = "knn_tpu_tenant_request_latency_seconds"
+
 # --- certified search (knn_tpu.parallel.sharded) -----------------------
 CERTIFIED_QUERIES = "knn_tpu_certified_queries_total"
 CERTIFIED_FALLBACKS = "knn_tpu_certified_fallback_queries_total"
@@ -123,6 +134,37 @@ CATALOG = {
         "histogram", (),
         "Per-request arrival-to-result latency through the queue "
         "(seconds) — includes the micro-batching wait."),
+    ADMISSION_ADMITTED: (
+        "counter", ("tenant",),
+        "Requests admitted past the admission controller, by tenant "
+        "('-' for untagged traffic)."),
+    ADMISSION_REJECTED: (
+        "counter", ("tenant", "reason"),
+        "Requests rejected AT SUBMIT with an explicit outcome "
+        "(queue_full / quota / deadline) instead of unbounded queue "
+        "growth."),
+    ADMISSION_SHED: (
+        "counter", ("tenant", "reason"),
+        "Admitted requests shed before device dispatch (expired: the "
+        "deadline passed while queued) — load the controller dropped "
+        "instead of wasting device time on."),
+    ADMISSION_WAIT_ESTIMATE: (
+        "gauge", (),
+        "Current wait estimate (seconds) the deadline-aware shedding "
+        "decision uses: outstanding rows (queued + in flight) x EWMA "
+        "per-row service time + the micro-batching deadline."),
+    TENANT_REQUESTS: (
+        "counter", ("tenant",),
+        "Lifetime requests per tenant through the serving layer (only "
+        "tenant-tagged submissions produce series)."),
+    TENANT_ERRORS: (
+        "counter", ("tenant",),
+        "Per-tenant requests resolved with an exception (admission "
+        "rejections/sheds count separately, not here)."),
+    TENANT_REQUEST_LATENCY: (
+        "histogram", ("tenant",),
+        "Per-tenant arrival-to-result latency (seconds) of ADMITTED "
+        "requests — the per-tenant SLO objectives read this."),
     CERTIFIED_QUERIES: (
         "counter", ("selector",),
         "Queries processed by ShardedKNN.search_certified."),

@@ -87,18 +87,28 @@ CURATED_FIELDS: Tuple[Tuple[str, str], ...] = (
     ("mfu", "higher"),
     ("mfu_device", "higher"),
     ("roofline_pct", "higher"),
+    # the measured latency-vs-throughput knee (knn_tpu.loadgen.knee):
+    # the max sustained request rate whose admitted p99 met the SLO —
+    # a knee that slides down is a serving regression even when the
+    # closed-loop headline holds
+    ("knee_qps", "higher"),
 )
 
 
 def curated_value(rec: dict, fname: str):
     """One curated field off a history line: top-level first (bench
-    hoists ``roofline_pct`` there), falling back into the line's
-    ``roofline`` block for lines curated before the hoist."""
+    hoists ``roofline_pct``/``knee_qps`` there), falling back into the
+    line's ``roofline``/``loadgen_knee`` block for lines curated
+    before the hoist."""
     v = rec.get(fname)
     if v is None and fname == "roofline_pct":
         block = rec.get("roofline")
         if isinstance(block, dict):
             v = block.get("roofline_pct")
+    if v is None and fname == "knee_qps":
+        block = rec.get("loadgen_knee")
+        if isinstance(block, dict):
+            v = block.get("knee_qps")
     return v
 
 #: verdict severity order (worst wins the overall verdict)

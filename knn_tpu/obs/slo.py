@@ -87,7 +87,13 @@ class Objective:
     catalog counter names (all label series summed) and ``target`` is
     the availability goal (budget = 1 - target).  ``kind="quantile"``:
     ``hist`` is a catalog histogram name and ``threshold`` the absolute
-    bound (seconds for the latency objectives) on ``quantile``."""
+    bound (seconds for the latency objectives) on ``quantile``.
+
+    ``group_by`` names a label (e.g. ``"tenant"``) to evaluate the
+    objective PER LABEL VALUE instead of over the summed surface: each
+    value gets its own burn rates, breach state, and edge-triggered
+    alert (reported as ``<name>:<value>``), so one tenant's burn pages
+    that tenant, not the fleet."""
 
     name: str
     kind: str  # "ratio" | "quantile"
@@ -102,6 +108,8 @@ class Objective:
     #: >= this multiple (default 1.0 — the threshold IS the line).
     #: None = the kind's default.
     burn_threshold: Optional[float] = None
+    #: evaluate per value of this label instead of summed (see above)
+    group_by: Optional[str] = None
 
     @property
     def effective_burn_threshold(self) -> float:
@@ -150,6 +158,17 @@ class Objective:
         if self.burn_threshold is not None and self.burn_threshold <= 0:
             raise ValueError(
                 f"SLO {self.name!r}: burn_threshold must be > 0")
+        if self.group_by is not None:
+            from knn_tpu.obs.names import CATALOG
+
+            metrics = ((self.num, self.den) if self.kind == "ratio"
+                       else (self.hist,))
+            for metric in metrics:
+                if self.group_by not in CATALOG[metric][1]:
+                    raise ValueError(
+                        f"SLO {self.name!r}: group_by={self.group_by!r} "
+                        f"is not a label of {metric!r} "
+                        f"(labels: {sorted(CATALOG[metric][1])})")
 
 
 #: the serving-stack defaults the ISSUE names: availability, tail
@@ -170,6 +189,17 @@ DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
     Objective(name="certified_false_alarm_rate", kind="ratio",
               num=names.CERTIFIED_FALSE_ALARMS, den=names.CERTIFIED_QUERIES,
               target=0.99),
+    # per-tenant attribution: the grouped objectives evaluate one burn
+    # rate PER TENANT over the tenant-labeled serving metrics, so a
+    # single tenant's burst pages as <name>:<tenant>, not globally.
+    # Tenant-free processes produce no tenant series -> empty groups,
+    # zero cost.
+    Objective(name="tenant_availability", kind="ratio",
+              num=names.TENANT_ERRORS, den=names.TENANT_REQUESTS,
+              target=0.999, group_by="tenant"),
+    Objective(name="tenant_request_p99", kind="quantile",
+              hist=names.TENANT_REQUEST_LATENCY, quantile="p99",
+              threshold=1.0, group_by="tenant"),
 )
 
 
@@ -207,15 +237,41 @@ def _summed(snapshot: dict, name: str) -> float:
     return float(sum(s["value"] for s in m["series"]))
 
 
-def _hist_summary(snapshot: dict, name: str) -> Optional[dict]:
+def _summed_by(snapshot: dict, name: str, label: str) -> Dict[str, float]:
+    """Per-label-value sums of a counter — the grouped objectives'
+    read: {label value: sum over the series carrying it}."""
+    m = snapshot.get(name)
+    out: Dict[str, float] = {}
+    if not m:
+        return out
+    for s in m["series"]:
+        val = s["labels"].get(label)
+        if val is None:
+            continue
+        out[val] = out.get(val, 0.0) + float(s["value"])
+    return out
+
+
+def _group_key(name: str, label: str, value: str) -> str:
+    """Composite sample-ring key for one label value of a grouped
+    counter (the ring stores flat {key: float} samples either way)."""
+    return f"{name}|{label}={value}"
+
+
+def _hist_summary(snapshot: dict, name: str,
+                  only: Optional[Tuple[str, str]] = None) -> Optional[dict]:
     """Merged summary across a histogram's label series (max of the
     quantiles — the conservative read for a threshold objective —
-    plus combined window metadata)."""
+    plus combined window metadata).  ``only=(label, value)`` restricts
+    the merge to series carrying that label value (grouped
+    objectives)."""
     m = snapshot.get(name)
     if not m:
         return None
     merged: Optional[dict] = None
     for s in m["series"]:
+        if only is not None and s["labels"].get(only[0]) != only[1]:
+            continue
         v = s["value"]
         if "p50" not in v:
             continue
@@ -257,11 +313,14 @@ class SLOEngine:
 
     # -- window machinery --------------------------------------------------
     def _ratio_counters(self):
+        """(counter name, group_by label or None) pairs the sample ring
+        must track — grouped objectives store one composite key per
+        label value instead of one summed key."""
         out = set()
         for o in self.objectives:
             if o.kind == "ratio":
-                out.add(o.num)
-                out.add(o.den)
+                out.add((o.num, o.group_by))
+                out.add((o.den, o.group_by))
         return out
 
     @staticmethod
@@ -290,8 +349,13 @@ class SLOEngine:
         now = self._clock() if now is None else float(now)
         snap = registry.snapshot()
         registry.counter(names.SLO_EVALUATIONS).inc()
-        current = {name: _summed(snap, name)
-                   for name in self._ratio_counters()}
+        current: Dict[str, float] = {}
+        for name, group_by in self._ratio_counters():
+            if group_by is None:
+                current[name] = _summed(snap, name)
+            else:
+                for val, s in _summed_by(snap, name, group_by).items():
+                    current[_group_key(name, group_by, val)] = s
         report: dict = {"objectives": {}, "breached": [],
                         "evaluated_at": round(time.time(), 3)}
         # ONE lock over read-evaluate-transition-append: concurrent
@@ -302,12 +366,19 @@ class SLOEngine:
         with self._lock:
             samples = list(self._samples)
             for o in self.objectives:
+                if o.group_by is not None:
+                    entry = self._eval_grouped(o, samples, current, snap,
+                                               now)
+                    report["objectives"][o.name] = entry
+                    for gval in entry["breached"]:
+                        report["breached"].append(f"{o.name}:{gval}")
+                    continue
                 if o.kind == "ratio":
                     entry = self._eval_ratio(o, samples, current, now)
                 else:
                     entry = self._eval_quantile(o, snap)
                 report["objectives"][o.name] = entry
-                self._transition(o, entry)
+                self._transition(o, o.name, entry)
                 if entry["breached"]:
                     report["breached"].append(o.name)
             # thinned append: bound the ring's TIME span from below so
@@ -317,9 +388,56 @@ class SLOEngine:
                 self._samples.append((now, current))
         return report
 
-    def _eval_ratio(self, o: Objective, samples, current, now) -> dict:
+    def _eval_grouped(self, o: Objective, samples, current, snap,
+                      now) -> dict:
+        """One evaluation per label value of ``o.group_by``: each value
+        gets the full window/burn machinery under the composite
+        objective key ``<name>:<value>`` (its own gauges, breach state,
+        and edge-triggered alert carrying the group label).  No series
+        for the label yet -> empty groups, nothing evaluated."""
+        groups: Dict[str, dict] = {}
+        if o.kind == "ratio":
+            # discover groups from num AND den series: a tenant with
+            # traffic but zero errors has no numerator series yet and
+            # must still be evaluated (and read healthy)
+            vals = set()
+            for name in (o.num, o.den):
+                prefix = _group_key(name, o.group_by, "")
+                vals.update(key[len(prefix):] for key in current
+                            if key.startswith(prefix))
+            for val in sorted(vals):
+                groups[val] = self._eval_ratio(
+                    o, samples, current, now,
+                    num_key=_group_key(o.num, o.group_by, val),
+                    den_key=_group_key(o.den, o.group_by, val),
+                    objective_label=f"{o.name}:{val}")
+        else:
+            m = snap.get(o.hist) or {}
+            vals = sorted({s["labels"].get(o.group_by)
+                           for s in m.get("series", ())} - {None})
+            for val in vals:
+                groups[val] = self._eval_quantile(
+                    o, snap, only=(o.group_by, val),
+                    objective_label=f"{o.name}:{val}")
+        breached = []
+        for val, entry in groups.items():
+            self._transition(o, f"{o.name}:{val}", entry,
+                             extra={o.group_by: val})
+            if entry["breached"]:
+                breached.append(val)
+        return {"kind": o.kind, "group_by": o.group_by,
+                "groups": groups, "breached": sorted(breached)}
+
+    def _eval_ratio(self, o: Objective, samples, current, now, *,
+                    num_key: Optional[str] = None,
+                    den_key: Optional[str] = None,
+                    objective_label: Optional[str] = None) -> dict:
         budget = 1.0 - o.target
         threshold = o.effective_burn_threshold
+        num_key = o.num if num_key is None else num_key
+        den_key = o.den if den_key is None else den_key
+        objective_label = (o.name if objective_label is None
+                           else objective_label)
         windows = {}
         confirms = []
         for label, span in self.windows:
@@ -331,9 +449,13 @@ class SLOEngine:
                 continue
             t0, vals0 = base
             actual = now - t0
-            dn = current[o.num] - vals0.get(o.num, 0.0)
-            dd = current[o.den] - vals0.get(o.den, 0.0)
-            ratio = (dn / dd) if dd > 0 else 0.0
+            dn = current.get(num_key, 0.0) - vals0.get(num_key, 0.0)
+            dd = current.get(den_key, 0.0) - vals0.get(den_key, 0.0)
+            # bad events with NO denominator growth is the worst ratio,
+            # not a healthy zero: a caller whose every request fails
+            # before the success-side counter increments (errors grow,
+            # requests don't) must breach, not hide behind div-by-zero
+            ratio = (dn / dd) if dd > 0 else (1.0 if dn > 0 else 0.0)
             burn = ratio / budget if budget > 0 else 0.0
             # a window with too little history may not CONFIRM a
             # breach: one second of data must not page the 600 s
@@ -349,7 +471,7 @@ class SLOEngine:
                 "num_delta": dn, "den_delta": dd,
                 "ratio": round(ratio, 6), "burn_rate": round(burn, 3),
             }
-            registry.gauge(names.SLO_BURN_RATE, objective=o.name,
+            registry.gauge(names.SLO_BURN_RATE, objective=objective_label,
                            window=label).set(burn)
         breached = (len(confirms) == len(self.windows)
                     and all(confirms))
@@ -358,14 +480,19 @@ class SLOEngine:
                 "num": o.num, "den": o.den,
                 "windows": windows, "breached": breached}
 
-    def _eval_quantile(self, o: Objective, snap) -> dict:
-        s = _hist_summary(snap, o.hist)
+    def _eval_quantile(self, o: Objective, snap, *,
+                       only: Optional[Tuple[str, str]] = None,
+                       objective_label: Optional[str] = None) -> dict:
+        s = _hist_summary(snap, o.hist, only=only)
         value = None if s is None else s.get(o.quantile)
         burn = None if value is None else value / o.threshold
         threshold = o.effective_burn_threshold  # quantile default 1.0
         if burn is not None:
-            registry.gauge(names.SLO_BURN_RATE, objective=o.name,
-                           window="hist").set(burn)
+            registry.gauge(
+                names.SLO_BURN_RATE,
+                objective=(o.name if objective_label is None
+                           else objective_label),
+                window="hist").set(burn)
         # which window the quantile came from rides the entry — the
         # number is meaningless without its sample count and wall span
         return {"kind": "quantile", "hist": o.hist,
@@ -379,24 +506,30 @@ class SLOEngine:
                 "breached": bool(burn is not None
                                  and burn >= threshold)}
 
-    def _transition(self, o: Objective, entry: dict) -> None:
-        was = self._breached.get(o.name, False)
+    def _transition(self, o: Objective, key: str, entry: dict,
+                    extra: Optional[dict] = None) -> None:
+        """Edge-triggered breach bookkeeping for one objective (or one
+        GROUP of a grouped objective — ``key`` is ``name:value`` then,
+        and ``extra`` carries the group label into the alert event)."""
+        was = self._breached.get(key, False)
         is_now = entry["breached"]
-        registry.gauge(names.SLO_BREACHED, objective=o.name).set(
+        registry.gauge(names.SLO_BREACHED, objective=key).set(
             1.0 if is_now else 0.0)
         if is_now == was:
             return
-        self._breached[o.name] = is_now
+        self._breached[key] = is_now
         detail = {k: entry[k] for k in ("windows", "value_s", "burn_rate",
                                         "window_samples", "window_span_s")
                   if k in entry}
+        if extra:
+            detail.update(extra)
         if is_now:
             registry.counter(names.SLO_BREACH_TRANSITIONS,
-                             objective=o.name).inc()
-            trace.emit_event("slo.alert", objective=o.name,
+                             objective=key).inc()
+            trace.emit_event("slo.alert", objective=key,
                              state="firing", kind=o.kind, **detail)
         else:
-            trace.emit_event("slo.alert", objective=o.name,
+            trace.emit_event("slo.alert", objective=key,
                              state="resolved", kind=o.kind, **detail)
 
     def active_breaches(self):

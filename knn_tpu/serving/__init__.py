@@ -22,11 +22,25 @@ neighbor identity + tie-break order match the unpadded direct call on
 every backend (distances additionally match bitwise on TPU, whose MXU
 reduction order is batch-shape invariant; see serving.engine).
 
+Admission control (:mod:`~knn_tpu.serving.admission`) layers onto the
+queue and is OFF by default: bounded depth with explicit rejection,
+deadline-aware load shedding, per-tenant token-bucket quotas, and
+starvation-safe aged-priority ordering — the controls the measured
+latency-vs-throughput knee (knn_tpu.loadgen) motivates.
+
 Entry points: ``ShardedKNN.search_bucketed()`` for the one-liner,
 ``ServingEngine`` + ``QueryQueue`` for a long-running service,
 ``--serve-buckets`` on the CLI, the ``serving`` mode in bench.py.
 """
 
+from knn_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionError,
+    DeadlineError,
+    QueueFullError,
+    QuotaExceededError,
+)
 from knn_tpu.serving.buckets import (
     DEFAULT_MAX_BUCKET,
     DEFAULT_MIN_BUCKET,
@@ -39,6 +53,12 @@ from knn_tpu.serving.engine import ServingEngine, latency_summary
 from knn_tpu.serving.queue import QueryQueue
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "AdmissionError",
+    "DeadlineError",
+    "QueueFullError",
+    "QuotaExceededError",
     "DEFAULT_MAX_BUCKET",
     "DEFAULT_MIN_BUCKET",
     "bucket_for",

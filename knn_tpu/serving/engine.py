@@ -97,7 +97,8 @@ class PendingSearch:
     rows away, and records the request's wall latency."""
 
     def __init__(self, engine: "ServingEngine", op: str, chunks, n: int,
-                 t0: float, trace_id: Optional[str] = None):
+                 t0: float, trace_id: Optional[str] = None,
+                 tenant: Optional[str] = None):
         self._engine = engine
         self._op = op
         self._chunks = chunks  # [(device outputs, redo, rows)]
@@ -107,6 +108,9 @@ class PendingSearch:
         self._error_counted = False
         #: request-scoped trace id (minted in submit; None when obs off)
         self.trace_id = trace_id
+        #: tenant tag for per-tenant latency/error attribution (None =
+        #: untagged: produces no tenant series at all)
+        self.tenant = tenant
 
     def result(self):
         from knn_tpu.parallel.sharded import _fetch_or_redispatch
@@ -136,7 +140,7 @@ class PendingSearch:
             # errors_total on every attempt
             if not self._error_counted:
                 self._error_counted = True
-                self._engine._record_error(self._op)
+                self._engine._record_error(self._op, tenant=self.tenant)
             raise
         if not self._done:  # latency is per request, not per .result() call
             self._done = True
@@ -147,7 +151,8 @@ class PendingSearch:
                             done - t_join, op=self._op)
             self._engine._record_latency(done - self._t0, self._op,
                                          trace_id=self.trace_id,
-                                         rows=self._n)
+                                         rows=self._n,
+                                         tenant=self.tenant)
         return res
 
 
@@ -369,12 +374,16 @@ class ServingEngine:
         return out, go, n
 
     def submit(self, queries, *, op: str = "search",
-               trace_id: Optional[str] = None) -> PendingSearch:
+               trace_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> PendingSearch:
         """Dispatch ``queries`` (async) and return a handle; oversize
         requests split into max-bucket chunks, each dispatched back to
         back so the device pipeline stays full.  ``trace_id`` scopes the
         request's spans (dispatch / compile / join); None mints a fresh
-        one when telemetry is enabled (knn_tpu.obs)."""
+        one when telemetry is enabled (knn_tpu.obs).  ``tenant`` tags
+        the request for per-tenant attribution (requests/errors/latency
+        series + the per-tenant SLO objectives); None produces no
+        tenant series — a tenant-free caller's telemetry is unchanged."""
         if op not in OPS:
             raise ValueError(f"unknown op {op!r}; expected one of {OPS}")
         q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
@@ -395,14 +404,17 @@ class ServingEngine:
                         self._dispatch_chunk(op, q[lo : lo + size], trace_id))
                     lo += size
         except Exception:
-            self._record_error(op)
+            self._record_error(op, tenant=tenant)
             raise
         with self._lock:
             self._requests += 1
             self._queries += int(q.shape[0])
         obs.counter(mn.SERVING_REQUESTS, op=op).inc()
         obs.counter(mn.SERVING_QUERIES, op=op).inc(int(q.shape[0]))
-        return PendingSearch(self, op, chunks, q.shape[0], t0, trace_id)
+        if tenant is not None:
+            obs.counter(mn.TENANT_REQUESTS, tenant=tenant).inc()
+        return PendingSearch(self, op, chunks, q.shape[0], t0, trace_id,
+                             tenant)
 
     def search(self, queries, *, return_sqrt: bool = False):
         """Bucketed exact search: (distances [Q, k], indices [Q, k]) as
@@ -457,7 +469,8 @@ class ServingEngine:
     # -- observability -----------------------------------------------------
     def _record_latency(self, seconds: float, op: str = "search", *,
                         trace_id: Optional[str] = None,
-                        rows: Optional[int] = None) -> None:
+                        rows: Optional[int] = None,
+                        tenant: Optional[str] = None) -> None:
         with self._lock:
             self._latencies_s.append((time.monotonic(), seconds))
         # the registry histogram is the machine-scrapable counterpart of
@@ -466,13 +479,19 @@ class ServingEngine:
         # registry default there), so quantiles can differ when the
         # engine was built with a non-default window
         obs.histogram(mn.SERVING_REQUEST_LATENCY, op=op).observe(seconds)
+        if tenant is not None:
+            obs.histogram(mn.TENANT_REQUEST_LATENCY,
+                          tenant=tenant).observe(seconds)
         obs.record_span("serving.request", trace_id, seconds, op=op,
                         **({} if rows is None else {"rows": int(rows)}))
 
-    def _record_error(self, op: str) -> None:
+    def _record_error(self, op: str, *,
+                      tenant: Optional[str] = None) -> None:
         with self._lock:
             self._errors += 1
         obs.counter(mn.SERVING_ERRORS, op=op).inc()
+        if tenant is not None:
+            obs.counter(mn.TENANT_ERRORS, tenant=tenant).inc()
 
     def _tuning_info(self) -> Optional[dict]:
         """Resolved kernel knobs + provenance for this placement's shape

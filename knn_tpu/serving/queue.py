@@ -17,6 +17,16 @@ returns before the device finishes), the **completer** blocks on
 transfers and resolves futures.  The batcher therefore keeps dispatching
 batch N+1 while batch N executes: micro-batching and dispatch-ahead
 compose.
+
+**Admission control** (knn_tpu.serving.admission) is layered on top and
+OFF by default: with ``max_depth``/``admission`` unset the queue's
+results and ``stats()`` are bitwise identical to the pre-admission
+queue (pinned in tests/test_admission.py).  Enabled, ``submit()`` can
+raise an explicit :class:`~knn_tpu.serving.admission.AdmissionError`
+(bounded depth, per-tenant quota, unmeetable deadline), queued requests
+whose deadline expires are shed at dispatch time instead of wasting a
+device pass, and dispatch order becomes aged-priority instead of FIFO —
+shed, don't collapse.
 """
 
 from __future__ import annotations
@@ -26,12 +36,37 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 
 from knn_tpu import obs
 from knn_tpu.obs import names as mn
+from knn_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    DeadlineError,
+)
+
+
+class _Pending:
+    """One queued request: the payload plus the telemetry/admission
+    fields that ride with it (arrival keeps the max-wait deadline per
+    request; the trace id keeps each request's telemetry its own even
+    after coalescing — one trace_id per REQUEST, never per batch)."""
+
+    __slots__ = ("q", "fut", "t_arr", "tid", "tenant", "deadline",
+                 "priority")
+
+    def __init__(self, q, fut, t_arr, tid, tenant=None, deadline=None,
+                 priority=0):
+        self.q = q
+        self.fut = fut
+        self.t_arr = t_arr
+        self.tid = tid
+        self.tenant = tenant
+        self.deadline = deadline  # absolute monotonic seconds, or None
+        self.priority = priority
 
 
 class QueryQueue:
@@ -44,6 +79,12 @@ class QueryQueue:
     ``max_rows`` rows accumulate, or when the OLDEST pending request has
     waited ``max_wait_ms`` — the deadline bounds worst-case added latency.
 
+    ``max_depth`` bounds OUTSTANDING work — queued plus in flight
+    (`submit` raises :class:`~knn_tpu.serving.admission.QueueFullError`
+    past it); ``admission`` is the full policy (quotas, deadline
+    shedding, priorities — knn_tpu.serving.admission).  Both default
+    off.
+
     Use as a context manager, or call :meth:`close` (flushes pending
     requests, then joins both threads).
     """
@@ -55,6 +96,8 @@ class QueryQueue:
         max_wait_ms: float = 2.0,
         max_rows: Optional[int] = None,
         op: str = "search",
+        max_depth: Optional[int] = None,
+        admission: Optional[AdmissionConfig] = None,
     ):
         from knn_tpu.serving.engine import OPS
 
@@ -62,18 +105,44 @@ class QueryQueue:
             raise ValueError(f"unknown op {op!r}; expected one of {OPS}")
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        if max_depth is not None and admission is not None \
+                and admission.max_depth is not None \
+                and admission.max_depth != max_depth:
+            raise ValueError(
+                f"conflicting depth bounds: max_depth={max_depth} vs "
+                f"admission.max_depth={admission.max_depth}")
         self.engine = engine
         self.op = op
         self.max_wait_s = max_wait_ms / 1e3
         self.max_rows = int(max_rows or engine.buckets[-1])
+        if admission is None and max_depth is not None:
+            # a bare depth bound is just the smallest possible policy
+            admission = AdmissionConfig(max_depth=max_depth)
+        elif admission is not None and max_depth is not None \
+                and admission.max_depth is None:
+            import dataclasses
+
+            admission = dataclasses.replace(admission,
+                                            max_depth=max_depth)
+        #: None = admission disabled = pre-admission behavior, bitwise
+        self._ctrl: Optional[AdmissionController] = (
+            None if admission is None else
+            AdmissionController(admission, base_wait_s=self.max_wait_s))
         self._cond = threading.Condition()
-        #: (queries, future, arrival time, trace id) — arrival rides
-        #: along so the max-wait deadline is per request, not per batch
-        #: window; the trace id keeps each request's telemetry its own
-        #: even after coalescing (one trace_id per REQUEST, never per
-        #: batch — knn_tpu.obs.trace)
-        self._pending: List[Tuple[np.ndarray, Future, float, object]] = []
+        self._pending: List[_Pending] = []
         self._pending_rows = 0
+        #: OUTSTANDING work = admitted and not yet resolved (queued OR
+        #: in flight through the engine's async pipeline).  Admission's
+        #: depth bound and wait estimate judge THIS, not the pending
+        #: list alone: dispatch-ahead drains pending into the device
+        #: pipeline almost instantly, so a pending-only bound would
+        #: never bind and overload would hide in flight (exactly the
+        #: collapse admission exists to prevent).
+        self._out_req = 0
+        self._out_rows = 0
+        #: previous batch-completion time (completer thread only):
+        #: feeds the inter-completion service-rate estimate
+        self._last_done_t: Optional[float] = None
         self._closed = False
         self._stats = {"requests": 0, "dispatches": 0, "coalesced_rows": 0,
                        "errors": 0}
@@ -100,7 +169,17 @@ class QueryQueue:
         obs.health.register_queue(self)
 
     # -- client side -------------------------------------------------------
-    def submit(self, queries) -> Future:
+    def submit(self, queries, *, tenant: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[int] = None) -> Future:
+        """Queue ``queries`` for a coalesced dispatch.  ``tenant`` tags
+        the request for per-tenant metrics/SLOs and quota accounting;
+        ``deadline_ms`` (relative to now) enables deadline-aware
+        shedding when the queue's admission policy has it on;
+        ``priority`` overrides the tenant's configured level (lower
+        dispatches first; ignored without admission).  Raises
+        :class:`~knn_tpu.serving.admission.AdmissionError` on an
+        explicit rejection — the request costs nothing downstream."""
         q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
         if q.ndim != 2 or q.shape[1] != self.engine._dim:
             # reject HERE, not in the batcher: a malformed request that
@@ -109,18 +188,41 @@ class QueryQueue:
             raise ValueError(
                 f"queries must be [N, {self.engine._dim}], got shape "
                 f"{q.shape}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}")
         fut: Future = Future()
         tid = obs.new_trace_id()  # THIS request's id, coalescing-proof
         with self._cond:
             if self._closed:
                 raise RuntimeError("QueryQueue is closed")
-            self._pending.append((q, fut, time.monotonic(), tid))
+            now = time.monotonic()
+            deadline = (None if deadline_ms is None
+                        else now + deadline_ms / 1e3)
+            prio = 0
+            if self._ctrl is not None:
+                # admission decides INSIDE the lock: depth/row reads and
+                # the append must be one atomic judgment, or two racing
+                # submits could both pass a max_depth of N-1.  The
+                # controller never takes the cond, so lock order is safe.
+                deadline = self._ctrl.admit(
+                    tenant=tenant, depth=self._out_req,
+                    rows=self._out_rows,
+                    deadline_s=deadline, now=now)
+                prio = (self._ctrl.priority_of(tenant)
+                        if priority is None else int(priority))
+            self._pending.append(_Pending(
+                q, fut, now, tid, tenant, deadline, prio))
             self._pending_rows += q.shape[0]
+            self._out_req += 1
+            self._out_rows += q.shape[0]
             self._stats["requests"] += 1
             self._g_depth_req.set(len(self._pending))
             self._g_depth_rows.set(self._pending_rows)
             self._cond.notify_all()
         obs.counter(mn.QUEUE_REQUESTS).inc()
+        if tenant is not None:
+            obs.counter(mn.TENANT_REQUESTS, tenant=tenant).inc()
         return fut
 
     def close(self) -> None:
@@ -146,6 +248,10 @@ class QueryQueue:
         with self._cond:
             out = dict(self._stats)
         out["latency_ms"] = latency_summary(list(self._lat))
+        # present ONLY when admission is enabled: the disabled queue's
+        # stats() shape is part of the bitwise-identity contract
+        if self._ctrl is not None:
+            out["admission"] = self._ctrl.stats()
         out["engine"] = self.engine.stats()
         return out
 
@@ -165,13 +271,73 @@ class QueryQueue:
         except Exception:  # noqa: BLE001 — cancelled in the race window
             pass
 
-    def _take_batch(self) -> Optional[List[Tuple[np.ndarray, Future, float, object]]]:
+    def _select_indices(self, now: float) -> List[int]:
+        """Indices (into ``_pending``) of the next batch, in dispatch
+        order.  FIFO without admission; with it, AGED priority — lower
+        ``priority - waited/aging_s`` first, arrival-stable among ties —
+        so configured priorities reorder under load but every waiting
+        request's rank rises monotonically (starvation-safe by
+        construction).  Either way requests stay whole and the batch
+        stops at the first candidate that would overflow ``max_rows``
+        (no skip-scan: size must never become a starvation channel)."""
+        if self._ctrl is None or (
+                not self._ctrl.config.priorities
+                and all(p.priority == 0 for p in self._pending)):
+            # no configured tenant levels AND no per-request override
+            # in the backlog: pure FIFO (explicit priority= on submit
+            # must reorder even without a tenant priority table)
+            order = range(len(self._pending))
+        else:
+            order = sorted(
+                range(len(self._pending)),
+                key=lambda i: (self._ctrl.effective_priority(
+                    self._pending[i].priority,
+                    now - self._pending[i].t_arr), i))
+        picked: List[int] = []
+        rows = 0
+        for i in order:
+            r = self._pending[i].q.shape[0]
+            if picked and rows + r > self.max_rows:
+                break
+            picked.append(i)
+            rows += r
+            if rows >= self.max_rows:
+                break
+        return picked
+
+    def _take_batch(self):
         """Block until a batch is due (rows >= max_rows, deadline hit, or
-        closing with work pending); None means closed and drained.
+        closing with work pending); returns ``(batch, shed)`` — ``shed``
+        are expired requests to resolve OUTSIDE the lock (a future's
+        done-callback may re-enter submit; resolving under the cond
+        could deadlock).  ``(None, shed)`` means closed and drained.
         Entries keep their arrival times so the completer can report
         honest arrival-to-result latency."""
+        shed: List[_Pending] = []
         with self._cond:
             while True:
+                # deadline-aware shedding: sweep requests whose deadline
+                # already passed BEFORE judging batch readiness — an
+                # expired request must neither ride a batch (wasted
+                # device rows) nor hold the max-wait clock
+                if (self._ctrl is not None and self._ctrl.config.shed
+                        and self._pending):
+                    now = time.monotonic()
+                    live = []
+                    for p in self._pending:
+                        if p.deadline is not None and p.deadline < now:
+                            shed.append(p)
+                            self._pending_rows -= p.q.shape[0]
+                        else:
+                            live.append(p)
+                    if shed and len(live) != len(self._pending):
+                        self._pending = live
+                        self._g_depth_req.set(len(self._pending))
+                        self._g_depth_rows.set(self._pending_rows)
+                        # deliver the expired futures NOW (outside the
+                        # lock) instead of holding them for up to a full
+                        # max-wait; the next call resumes batch-taking
+                        return [], shed
                 if self._pending:
                     if self._closed or self._pending_rows >= self.max_rows:
                         break
@@ -179,66 +345,103 @@ class QueryQueue:
                     # left behind by a full earlier batch retains its
                     # original deadline — max_wait_ms stays a real
                     # worst-case bound, not a restartable clock
-                    wait = self._pending[0][2] + self.max_wait_s - time.monotonic()
+                    wake = self._pending[0].t_arr + self.max_wait_s
+                    if self._ctrl is not None and self._ctrl.config.shed:
+                        # ...and never sleep PAST a request deadline: a
+                        # large max-wait must not hold an expired
+                        # future until the dispatch clock fires (the
+                        # sweep above can only shed while awake)
+                        for p in self._pending:
+                            if p.deadline is not None and p.deadline < wake:
+                                wake = p.deadline
+                    wait = wake - time.monotonic()
                     if wait <= 0:
+                        if wake < self._pending[0].t_arr + self.max_wait_s:
+                            continue  # a deadline fired, not the batch
+                            # clock: re-sweep and keep coalescing
                         break
                     self._cond.wait(timeout=wait)
                 elif self._closed:
-                    return None
+                    return None, shed
                 else:
                     self._cond.wait()
             # whole requests only: a request is never split across
             # micro-batches (oversize batches split inside the engine)
-            batch: List[Tuple[np.ndarray, Future, float, object]] = []
-            rows = 0
-            while self._pending and (
-                not batch or rows + self._pending[0][0].shape[0] <= self.max_rows
-            ):
-                batch.append(self._pending.pop(0))
-                rows += batch[-1][0].shape[0]
-            self._pending_rows -= rows
+            now = time.monotonic()
+            batch = [self._pending[i] for i in self._select_indices(now)]
+            taken = set(id(p) for p in batch)
+            self._pending = [p for p in self._pending
+                             if id(p) not in taken]
+            self._pending_rows -= sum(p.q.shape[0] for p in batch)
             self._g_depth_req.set(len(self._pending))
             self._g_depth_rows.set(self._pending_rows)
-            return batch
+            return batch, shed
+
+    def _retire(self, items: List[_Pending]) -> None:
+        """Resolved requests leave the outstanding count — whatever the
+        outcome (ok, shed, error), the admission depth frees up."""
+        with self._cond:
+            for p in items:
+                self._out_req -= 1
+                self._out_rows -= p.q.shape[0]
+
+    def _shed_expired(self, shed: List[_Pending]) -> None:
+        for p in shed:
+            self._ctrl.record_shed(p.tenant, "expired")
+            # reason "expired" matches the metric label above, so the
+            # caller-visible outcome and knn_tpu_admission_shed_total
+            # speak one vocabulary
+            self._resolve(p.fut, exc=DeadlineError(
+                "deadline expired while queued (shed before dispatch)",
+                tenant=p.tenant, reason="expired"))
+        self._retire(shed)
 
     def _batcher(self) -> None:
         while True:
-            batch = self._take_batch()
+            batch, shed = self._take_batch()
+            if shed:
+                self._shed_expired(shed)
             if batch is None:
                 break
+            if not batch:
+                continue
             try:
                 # the concatenate sits INSIDE the guard: any surprise in
                 # batch assembly must resolve this batch's futures, never
                 # kill the batcher thread (a dead batcher hangs every
                 # later request and deadlocks close())
-                arrays = [q for q, _, _, _ in batch]
+                arrays = [p.q for p in batch]
                 cat = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
                 offsets = np.cumsum([0] + [a.shape[0] for a in arrays])
                 # every member's queue-wait span closes at dispatch time,
                 # under its OWN trace id — the coalesced engine request
                 # gets a fresh batch-level id, linked below
                 t_disp = time.monotonic()
-                for q, _, t_arr, tid in batch:
-                    obs.record_span("serving.queue_wait", tid,
-                                    t_disp - t_arr, rows=int(q.shape[0]))
-                    obs.histogram(mn.QUEUE_WAIT).observe(t_disp - t_arr)
+                for p in batch:
+                    obs.record_span("serving.queue_wait", p.tid,
+                                    t_disp - p.t_arr, rows=int(p.q.shape[0]))
+                    obs.histogram(mn.QUEUE_WAIT).observe(t_disp - p.t_arr)
+                    # the loadgen driver reads this to record per-request
+                    # dispatch time (arrival it already knows)
+                    p.fut.dispatch_t = t_disp
                 handle = self.engine.submit(cat, op=self.op)
                 obs.emit_event(
                     "queue.dispatch", op=self.op,
                     batch_trace_id=handle.trace_id,
-                    member_trace_ids=[tid for _, _, _, tid in batch],
+                    member_trace_ids=[p.tid for p in batch],
                     rows=int(offsets[-1]), requests=len(batch))
             except Exception as e:  # noqa: BLE001 — resolve, don't kill the loop
-                self._record_errors(len(batch))
-                for _, fut, _, _ in batch:
-                    self._resolve(fut, exc=e)
+                self._record_errors(batch)
+                for p in batch:
+                    self._resolve(p.fut, exc=e)
+                self._retire(batch)
                 continue
             with self._cond:
                 self._stats["dispatches"] += 1
                 self._stats["coalesced_rows"] += int(offsets[-1])
             obs.counter(mn.QUEUE_DISPATCHES).inc()
             obs.counter(mn.QUEUE_COALESCED_ROWS).inc(int(offsets[-1]))
-            self._done.put((handle, batch, offsets))
+            self._done.put((handle, batch, offsets, t_disp))
         self._done.put(None)
 
     # -- completer thread --------------------------------------------------
@@ -247,33 +450,59 @@ class QueryQueue:
             item = self._done.get()
             if item is None:
                 break
-            handle, batch, offsets = item
+            handle, batch, offsets, t_disp = item
             try:
                 res = handle.result()
             except Exception as e:  # noqa: BLE001 — per-batch failure isolation
-                self._record_errors(len(batch))
-                for _, fut, _, _ in batch:
-                    self._resolve(fut, exc=e)
+                self._record_errors(batch)
+                for p in batch:
+                    self._resolve(p.fut, exc=e)
+                self._retire(batch)
                 continue
             done_t = time.monotonic()
-            for j, (q, fut, t_arr, tid) in enumerate(batch):
+            if self._ctrl is not None:
+                # feed the wait estimator: this batch's measured rows/s
+                # is what the NEXT submit's shedding decision runs on.
+                # Two candidate spans, take the SMALLER: dispatch-to-
+                # done includes waiting behind in-flight predecessors
+                # (exact when idle, ~pipeline-depth x inflated under
+                # load — and the estimate multiplies by outstanding
+                # rows, which already count those predecessors), while
+                # the inter-completion gap is exact under saturation
+                # but includes idle time at low load.  min() is right
+                # in both regimes; systematic over-estimation would
+                # shed deadlines that were comfortably feasible.
+                span = done_t - t_disp
+                prev = self._last_done_t
+                if prev is not None:
+                    span = min(span, done_t - prev)
+                self._last_done_t = done_t
+                self._ctrl.observe_service(int(offsets[-1]), span)
+            for j, p in enumerate(batch):
                 lo, hi = int(offsets[j]), int(offsets[j + 1])
                 if self.op == "search":
                     d, i = res
-                    self._resolve(fut, (d[lo:hi], i[lo:hi]))
+                    self._resolve(p.fut, (d[lo:hi], i[lo:hi]))
                 else:
-                    self._resolve(fut, res[lo:hi])
-                self._lat.append((done_t, done_t - t_arr))
+                    self._resolve(p.fut, res[lo:hi])
+                self._lat.append((done_t, done_t - p.t_arr))
                 # arrival-to-result under the request's own trace id —
                 # what a caller tuning max_wait_ms actually experiences
                 obs.histogram(mn.QUEUE_REQUEST_LATENCY).observe(
-                    done_t - t_arr)
-                obs.record_span("serving.queued_request", tid,
-                                done_t - t_arr, op=self.op,
-                                rows=int(q.shape[0]),
+                    done_t - p.t_arr)
+                if p.tenant is not None:
+                    obs.histogram(mn.TENANT_REQUEST_LATENCY,
+                                  tenant=p.tenant).observe(done_t - p.t_arr)
+                obs.record_span("serving.queued_request", p.tid,
+                                done_t - p.t_arr, op=self.op,
+                                rows=int(p.q.shape[0]),
                                 batch_trace_id=handle.trace_id)
+            self._retire(batch)
 
-    def _record_errors(self, n: int) -> None:
+    def _record_errors(self, batch: List[_Pending]) -> None:
         with self._cond:
-            self._stats["errors"] += n
-        obs.counter(mn.QUEUE_ERRORS).inc(n)
+            self._stats["errors"] += len(batch)
+        obs.counter(mn.QUEUE_ERRORS).inc(len(batch))
+        for p in batch:
+            if p.tenant is not None:
+                obs.counter(mn.TENANT_ERRORS, tenant=p.tenant).inc()

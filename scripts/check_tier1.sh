@@ -29,6 +29,7 @@ if [ "${1:-}" = "--fast" ]; then
     tests/test_pallas_knn.py tests/test_pallas_streaming.py \
     tests/test_quantize.py tests/test_tuning.py tests/test_obs.py \
     tests/test_slo.py tests/test_sentinel.py tests/test_roofline.py \
+    tests/test_loadgen.py tests/test_admission.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "${1:-}" = "--strict" ]; then

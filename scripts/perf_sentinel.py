@@ -7,10 +7,11 @@ Three modes, all reading the repo's recorded bench history
 ``--lint``
     CI config validation: the SLO objectives (defaults or
     ``KNN_TPU_SLO_CONFIG``) parse and reference only cataloged metrics,
-    the bench history parses into baselines, and every ``roofline``
-    block a history line carries is structurally valid
-    (knn_tpu.obs.roofline.validate_block — a malformed block would
-    poison the roofline_pct baselines silently).  This is what
+    the bench history parses into baselines, and every ``roofline`` /
+    ``loadgen_knee`` block a history line carries is structurally valid
+    (knn_tpu.obs.roofline.validate_block and
+    knn_tpu.loadgen.knee.validate_knee_block — a malformed block would
+    poison the roofline_pct / knee_qps baselines silently).  This is what
     ``scripts/check_tier1.sh --fast`` runs — a broken SLO config or a
     corrupted history fixture fails here, not at serve time.
 
@@ -91,6 +92,26 @@ def run_lint(repo) -> int:
               f"{n_errored} advisory-error blocks skipped)")
     except Exception as e:  # noqa: BLE001
         errors.append(f"roofline blocks: {type(e).__name__}: {e}")
+    try:
+        from knn_tpu.loadgen.knee import validate_knee_block
+
+        n_knee, n_before = 0, len(errors)
+        for rec in records:
+            block = rec.get("loadgen_knee")
+            if block is None:
+                continue
+            n_knee += 1
+            for err in validate_knee_block(block):
+                errors.append(
+                    f"loadgen_knee block on {rec.get('metric')} "
+                    f"({rec.get('_source')}): {err}")
+        if len(errors) == n_before:
+            print(f"knee blocks: OK ({n_knee} validated)")
+        else:
+            print(f"knee blocks: {len(errors) - n_before} violation(s) "
+                  f"across {n_knee} blocks")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"knee blocks: {type(e).__name__}: {e}")
     for err in errors:
         print(f"perf_sentinel --lint: {err}", file=sys.stderr)
     return 1 if errors else 0

@@ -203,6 +203,32 @@ except Exception as _e:  # noqa: BLE001 — curation must never fail on it
     print(f"roofline curation skipped: {type(_e).__name__}: {_e}",
           file=sys.stderr)
 
+# knee curation (knn_tpu.loadgen.knee): a fresh line carrying a
+# loadgen_knee block (bench's knee mode / cli loadgen) is validated —
+# malformed blocks REFUSED, the roofline discipline: a corrupt block
+# would silently poison the sentinel's knee_qps baselines — and
+# knee_qps hoisted top-level for the curated-field baselines.
+try:
+    from knn_tpu.loadgen.knee import validate_knee_block as _vkb
+
+    for cfg, rec in best.items():
+        if rec["stale"]:
+            continue  # a republished number keeps its old block verbatim
+        block = rec.get("loadgen_knee")
+        if block is None:
+            continue
+        errs = _vkb(block)
+        if errs:
+            sys.exit(f"refusing to emit curated line for {cfg}: "
+                     f"malformed loadgen_knee block: {'; '.join(errs)}")
+        if block.get("knee_qps") is not None:
+            rec.setdefault("knee_qps", block["knee_qps"])
+except SystemExit:
+    raise
+except Exception as _e:  # noqa: BLE001 — curation must never fail on it
+    print(f"knee curation skipped: {type(_e).__name__}: {_e}",
+          file=sys.stderr)
+
 # perf-regression sentinel (knn_tpu.obs.sentinel): every curated line
 # carries its verdict against the robust baseline of STRICTLY EARLIER
 # rounds (a line never seeds the baseline it is judged against); stale
@@ -244,4 +270,8 @@ with open(DST, "w") as f:
                  f"/{r.get('bound_class')}"
                  if isinstance(r.get("roofline_pct"), (int, float))
                  else "")
+              # the measured serving knee (loadgen sweep), when the
+              # session ran one: max SLO-meeting sustained request rate
+              + (f" knee={r['knee_qps']}q/s"
+                 if isinstance(r.get("knee_qps"), (int, float)) else "")
               + (" STALE" if r["stale"] else ""))

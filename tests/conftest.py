@@ -26,6 +26,16 @@ os.environ["KNN_TPU_TUNE_CACHE"] = os.path.join(
 for _knob in ("KNN_TPU_OBS", "KNN_TPU_OBS_LOG",
               "KNN_TPU_OBS_LOG_MAX_BYTES", "KNN_TPU_SLO_CONFIG"):
     os.environ.pop(_knob, None)
+# isolate the admission-control and loadgen knobs: a developer shell's
+# ambient KNN_TPU_ADMISSION_* would silently flip every QueryQueue in
+# the suite onto the admission path (AdmissionConfig.from_env treats
+# ANY set knob as an opt-in), breaking the disabled-mode
+# bitwise-identity pins (tests that exercise admission build explicit
+# AdmissionConfig objects or set their own env)
+for _knob in [k for k in os.environ
+              if k.startswith(("KNN_TPU_ADMISSION_", "KNN_TPU_LOADGEN_",
+                               "KNN_BENCH_KNEE_"))]:
+    os.environ.pop(_knob, None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

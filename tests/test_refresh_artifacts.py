@@ -336,3 +336,36 @@ def test_malformed_roofline_block_refused(tmp_path):
     assert r.returncode != 0
     assert "malformed roofline block" in (r.stderr + r.stdout)
     assert not (tmp_path / "TPU_BENCH_r09.jsonl").exists()
+
+
+def test_knee_block_curated_and_printed(tmp_path):
+    # a fresh line carrying a loadgen_knee block (bench knee mode /
+    # cli loadgen) gets knee_qps hoisted top-level — the sentinel's
+    # curated field — and the per-line print shows knee= beside the
+    # sentinel verdict
+    block = {"version": 1, "slo_p99_ms": 100.0,
+             "rate_steps": [{"rate_qps": 200.0, "offered": 190,
+                             "ok": 180, "achieved_qps": 171.3,
+                             "shed_fraction": 0.05, "within_slo": True}],
+             "knee_qps": 171.3, "knee_rate_qps": 200.0}
+    rec = dict(_line(120.0, gate=True, cfg="knn_qps_knee"),
+               loadgen_knee=block)
+    r = _run_with_repo(tmp_path, 9, [rec])
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "TPU_BENCH_r09.jsonl").read_text().splitlines()]
+    (row,) = rows
+    assert row["knee_qps"] == 171.3
+    assert row["loadgen_knee"] == block
+    assert "knee=171.3q/s" in r.stdout
+
+
+def test_malformed_knee_block_refused(tmp_path):
+    # a corrupt knee block would silently poison the sentinel's
+    # knee_qps baselines — the refresher must refuse the round
+    bad = dict(_line(120.0, gate=True),
+               loadgen_knee={"version": 1, "rate_steps": []})
+    r = _run_with_repo(tmp_path, 9, [bad])
+    assert r.returncode != 0
+    assert "malformed loadgen_knee block" in (r.stderr + r.stdout)
+    assert not (tmp_path / "TPU_BENCH_r09.jsonl").exists()

@@ -46,7 +46,12 @@ def test_default_objectives_validate_against_catalog():
     objs = slo.load_objectives()
     assert {o.name for o in objs} == {
         "serving_availability", "serving_request_p99", "queue_wait_p95",
-        "certified_fallback_rate", "certified_false_alarm_rate"}
+        "certified_fallback_rate", "certified_false_alarm_rate",
+        "tenant_availability", "tenant_request_p99"}
+    # the tenant objectives are the grouped ones: one burn-rate
+    # evaluation per tenant label value, not one global sum
+    assert {o.name for o in objs if o.group_by == "tenant"} == {
+        "tenant_availability", "tenant_request_p99"}
     for o in objs:
         o.validate()  # must not raise
 
