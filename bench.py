@@ -809,9 +809,17 @@ def main() -> None:
             if qps[False] and qps[True]:
                 obs_overhead = round(
                     (qps[False] - qps[True]) / qps[False] * 100.0, 3)
+        # worst recent requests' trace ids (histogram exemplars via
+        # engine stats): the replay's tail percentiles become
+        # joinable against spans/waterfalls (cli waterfall) when an
+        # obs log or postmortem bundle was captured alongside
+        slowest_ids = [e.get("trace_id")
+                       for e in report.get("slowest_requests") or []
+                       if e.get("trace_id")][:5]
         return {
             "sustained_qps": report["sustained_qps"],
             "latency_ms": report["latency_ms"],
+            **({"slowest_trace_ids": slowest_ids} if slowest_ids else {}),
             # telemetry overhead on this trace (None = not measured; set
             # KNN_BENCH_OBS_OVERHEAD=1): negative values are replay
             # noise — the honest reading is "below noise floor"

@@ -52,6 +52,20 @@ measured percent of roofline.  The planning companion of the bench's
 per-line ``roofline`` blocks: answer "what would int8 x streaming be
 bounded by at this shape?" before burning chip time on it.
 
+    python -m knn_tpu.cli waterfall --bundle postmortem-....json
+    python -m knn_tpu.cli waterfall --log events.jsonl --top 5
+    python -m knn_tpu.cli waterfall --port 9100 --trace-id 3fa9c1d2e4b56a78
+
+renders per-request latency **waterfalls** (queue_wait / admission /
+dispatch / compile / device / join / deliver segments tiling each
+request's measured latency, gaps explicit as ``unattributed``) plus the
+aggregated critical-path attribution (which segment dominates at p50 vs
+p99, per tenant and per bucket) — from a flight-recorder postmortem
+bundle (``KNN_TPU_POSTMORTEM_DIR``), a JSONL event log (the rotated
+``.1`` generation is merged automatically), or a running process's
+``/waterfallz`` endpoint.  Jax-free by construction
+(docs/OBSERVABILITY.md "Waterfalls & exemplars").
+
     python -m knn_tpu.cli loadgen --synthetic 500 --slo-p99-ms 20
     python -m knn_tpu.cli loadgen --n 100000 --dim 64 --rates 50,100,200 \\
         --max-depth 64 --shed --deadline-ms 250 --tenants gold:3,free:1
@@ -446,6 +460,115 @@ def run_roofline(args: argparse.Namespace) -> int:
     return 0
 
 
+def build_waterfall_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu waterfall",
+        description="Render per-request latency waterfalls and the "
+        "aggregated critical-path attribution (knn_tpu.obs.waterfall) "
+        "from a flight-recorder postmortem bundle, a JSONL event log "
+        "(KNN_TPU_OBS_LOG; the rotated .1 generation is merged), or a "
+        "running process's /waterfallz endpoint — offline and "
+        "jax-free.  Exit 0 rendered, 1 unreadable source.",
+    )
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--bundle", default=None, metavar="PATH",
+                     help="read a postmortem bundle written by the "
+                     "flight recorder (KNN_TPU_POSTMORTEM_DIR)")
+    src.add_argument("--log", default=None, metavar="PATH",
+                     help="read a JSONL event log (KNN_TPU_OBS_LOG / "
+                     "--obs-log); <PATH>.1 is merged when present")
+    src.add_argument("--port", type=int, default=None,
+                     help="fetch /waterfallz from http://HOST:PORT (a "
+                     "process started with --metrics-port)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="endpoint host for --port (default localhost)")
+    p.add_argument("--trace-id", action="append", default=[],
+                   metavar="ID", help="render only these request ids "
+                   "(repeatable; default: the --top slowest)")
+    p.add_argument("--top", type=int, default=8,
+                   help="how many waterfalls to render, slowest first")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw forensics payload JSON instead "
+                   "of the rendering")
+    return p
+
+
+def run_waterfall(args: argparse.Namespace) -> int:
+    """The `waterfall` subcommand — jax-free (knn_tpu.obs imports no
+    JAX): tail forensics must not pay a backend init."""
+    import json
+    import urllib.request
+
+    from knn_tpu.obs import waterfall
+
+    if args.port is not None:
+        url = f"http://{args.host}:{args.port}/waterfallz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                payload = json.loads(r.read().decode())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"waterfallz endpoint {url} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        wfs = payload.get("waterfalls") or {}
+        agg = payload.get("attribution") or waterfall.attribute(wfs)
+        dvr = payload.get("device_vs_roofline")
+    elif args.bundle is not None:
+        from knn_tpu.obs import blackbox
+
+        try:
+            payload = blackbox.read_bundle(args.bundle)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"cannot read bundle {args.bundle}: {e}",
+                  file=sys.stderr)
+            return 1
+        # the bundle embeds the raw event ring — reconstruct from it so
+        # offline rendering uses the same code path as live
+        wfs = waterfall.reconstruct(payload.get("events") or [])
+        agg = payload.get("attribution") or waterfall.attribute(wfs)
+        dvr = payload.get("device_vs_roofline")
+        if not args.json:
+            # header stays off the --json stdout: that output must
+            # parse as one JSON document
+            print(f"postmortem bundle: "
+                  f"objective={payload.get('objective')} "
+                  f"state={payload.get('state')} "
+                  f"written_at={payload.get('written_at')} "
+                  f"pid={payload.get('pid')}")
+    else:
+        try:
+            events = waterfall.read_jsonl_events(args.log)
+        except (OSError, ValueError) as e:
+            print(f"cannot read event log {args.log}: {e}",
+                  file=sys.stderr)
+            return 1
+        wfs = waterfall.reconstruct(events)
+        agg = waterfall.attribute(wfs)
+        dvr = waterfall.device_vs_roofline(wfs)
+        payload = {"waterfalls": wfs, "attribution": agg,
+                   "device_vs_roofline": dvr}
+    if args.json:
+        print(json.dumps(payload, indent=1, sort_keys=True, default=str))
+        return 0
+    if args.trace_id:
+        picked = [wfs[t] for t in args.trace_id if t in wfs]
+        missing = [t for t in args.trace_id if t not in wfs]
+        for t in missing:
+            print(f"trace id {t}: no reconstructable request in this "
+                  f"source", file=sys.stderr)
+    else:
+        picked = sorted(wfs.values(),
+                        key=lambda w: -(w.get("total_s") or 0.0))
+        picked = picked[: max(0, args.top)]
+    print(waterfall.render_attribution(agg, dvr))
+    for w in picked:
+        print(waterfall.render_waterfall(w))
+    if not picked:
+        print("no reconstructable requests in this source",
+              file=sys.stderr)
+    return 0
+
+
 def build_loadgen_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="knn_tpu loadgen",
@@ -706,6 +829,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_doctor(build_doctor_parser().parse_args(argv[1:]))
     if argv[:1] == ["roofline"]:
         return run_roofline(build_roofline_parser().parse_args(argv[1:]))
+    if argv[:1] == ["waterfall"]:
+        return run_waterfall(build_waterfall_parser().parse_args(argv[1:]))
     if argv[:1] == ["loadgen"]:
         largs = build_loadgen_parser().parse_args(argv[1:])
         if largs.cpu_devices:

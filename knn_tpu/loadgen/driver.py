@@ -63,8 +63,10 @@ class ResultLog:
         self._dropped = 0
         self._outcomes: Dict[str, int] = {}
         self._by_tenant: Dict[str, Dict[str, int]] = {}
-        #: (tenant, latency_s) of ok-outcome requests, bounded with the
-        #: records (percentiles are window truth, counts are lifetime)
+        #: (tenant, latency_s, trace_id) of ok-outcome requests, bounded
+        #: with the records (percentiles are window truth, counts are
+        #: lifetime); the trace id is what joins a knee artifact's tail
+        #: requests back to their spans/waterfalls
         self._lat: deque = deque(maxlen=int(cap))
 
     def add(self, rec: dict) -> None:
@@ -77,7 +79,8 @@ class ResultLog:
             slot = self._by_tenant.setdefault(rec["tenant"], {})
             slot[out] = slot.get(out, 0) + 1
             if out == "ok" and rec.get("latency_s") is not None:
-                self._lat.append((rec["tenant"], rec["latency_s"]))
+                self._lat.append((rec["tenant"], rec["latency_s"],
+                                  rec.get("trace_id")))
 
     def records(self) -> List[dict]:
         with self._lock:
@@ -168,6 +171,11 @@ def run_workload(target, requests: Sequence[Request], *, queries,
                          "dispatch_s": None, "completion_s": None,
                          "latency_s": None})
                 continue
+            # the queue stamps its trace id on the future at submit
+            # (alongside the dispatch_t contract): recorded so a knee
+            # artifact's shed/tail requests can be joined against
+            # traces and waterfalls
+            base["trace_id"] = getattr(fut, "trace_id", None)
             # completion is stamped by the RESOLVING thread, not by the
             # waiter: the waiters drain a FIFO, so a request completing
             # out of order (priority scheduling) would otherwise have
@@ -238,12 +246,12 @@ def report(log: ResultLog, *, offered: int, wall_s: float) -> dict:
                    if k.startswith("rejected:"))
     shed = sum(v for k, v in outcomes.items() if k.startswith("shed:"))
     errors = outcomes.get("error", 0)
-    lat_all = [s for _, s in snap["latencies"]]
+    lat_all = [s for _, s, _ in snap["latencies"]]
     per_tenant = {}
     for tenant, outs in sorted(snap["by_tenant"].items()):
         t_ok = outs.get("ok", 0)
         t_total = sum(outs.values())
-        t_lat = [s for t, s in snap["latencies"] if t == tenant]
+        t_lat = [s for t, s, _ in snap["latencies"] if t == tenant]
         per_tenant[tenant] = {
             "offered": t_total,
             "ok": t_ok,
@@ -269,6 +277,15 @@ def report(log: ResultLog, *, offered: int, wall_s: float) -> dict:
         "shed_fraction": (round((offered - ok) / offered, 4)
                           if offered else None),
         "latency_ms": _percentiles_ms(lat_all),
+        #: the worst ADMITTED requests by latency, with the trace ids
+        #: the queue stamped at submit — the knee sweep's tail becomes
+        #: cross-examinable against spans/waterfalls (cli waterfall)
+        "slowest": [
+            {"tenant": t, "latency_ms": round(s * 1e3, 3),
+             "trace_id": tid}
+            for t, s, tid in sorted(snap["latencies"],
+                                    key=lambda x: -x[1])[:5]
+        ],
         "per_tenant": per_tenant,
         "records_kept": snap["records_kept"],
         "records_dropped": snap["records_dropped"],

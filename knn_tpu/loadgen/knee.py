@@ -105,6 +105,9 @@ def knee_sweep(target_factory: Callable[[], object],
             "admitted_p99_ms": lat.get("p99"),
             "within_slo": bool(within),
             "per_tenant": rep.get("per_tenant"),
+            # worst admitted requests' trace ids: the step's tail is
+            # joinable against spans/waterfalls (cli waterfall)
+            "slowest": rep.get("slowest"),
         })
     return knee_block(steps, slo_p99_ms=slo_p99_ms)
 

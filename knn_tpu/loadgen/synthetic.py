@@ -53,6 +53,8 @@ class SyntheticTarget:
     def submit(self, queries, *, tenant: Optional[str] = None,
                deadline_ms: Optional[float] = None,
                priority: Optional[int] = None) -> Future:
+        from knn_tpu.obs import new_trace_id
+
         now = time.monotonic()
         with self._lock:
             if self.max_depth is not None and self._depth >= self.max_depth:
@@ -61,6 +63,9 @@ class SyntheticTarget:
                     tenant=tenant)
             self._depth += 1
         fut: Future = Future()
+        # same surface the real queue stamps (the loadgen driver's
+        # ResultLog records it): ids stay jax-free via knn_tpu.obs
+        fut.trace_id = new_trace_id()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         self._q.put((fut, tenant, deadline))
         return fut

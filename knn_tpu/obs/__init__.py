@@ -26,6 +26,13 @@ writes through here instead of keeping private ad-hoc counters:
 - **Device trace capture** (:mod:`knn_tpu.obs.profiler`): opt-in
   ``jax.profiler.trace`` wrapping of bench/tuning runs
   (``KNN_TPU_PROFILE_DIR``), for the slack the model can't name.
+- **Tail forensics** (:mod:`knn_tpu.obs.waterfall`): per-request
+  latency waterfalls reconstructed from the span stream, critical-path
+  attribution at p50 vs p99 per tenant/bucket, histogram->trace
+  exemplars, and the slowest-requests tables.
+- **Flight recorder** (:mod:`knn_tpu.obs.blackbox`): one atomic,
+  retention-capped postmortem bundle per edge-triggered SLO breach
+  (``KNN_TPU_POSTMORTEM_DIR``), readable offline by ``cli waterfall``.
 
 The package itself imports no JAX (jax_hooks defers it), so the CLI's
 flag parsing and the lint script stay import-light.
@@ -35,12 +42,14 @@ Metric catalog, span lifecycle, and overhead numbers:
 """
 
 from knn_tpu.obs import (  # noqa: F401
+    blackbox,
     health,
     names,
     profiler,
     roofline,
     sentinel,
     slo,
+    waterfall,
 )
 from knn_tpu.obs.export import (  # noqa: F401
     compact_snapshot,
@@ -83,12 +92,13 @@ from knn_tpu.obs.trace import (  # noqa: F401
 
 __all__ = [
     "NOOP", "Counter", "EventLog", "Gauge", "Histogram",
-    "MetricsRegistry", "Objective", "SLOEngine", "compact_snapshot",
+    "MetricsRegistry", "Objective", "SLOEngine", "blackbox",
+    "compact_snapshot",
     "counter", "emit_event", "enabled", "gauge", "get_event_log",
     "get_registry", "get_slo_engine", "health", "histogram",
     "install_compile_hook", "load_objectives", "names", "new_trace_id",
     "profiler", "prometheus_text", "record_span", "reset",
     "reset_event_log", "reset_slo_engine", "roofline", "sentinel", "slo",
     "slo_report", "snapshot", "span", "start_metrics_server",
-    "write_json_snapshot",
+    "waterfall", "write_json_snapshot",
 ]

@@ -64,6 +64,19 @@ def prometheus_text(snapshot: Optional[dict] = None) -> str:
                         lines.append(
                             f"{name}{_labels_str(ls, ('quantile', q))} "
                             f"{v[key]}")
+                if v.get("exemplars"):
+                    # the worst retained sample's trace id, value, and
+                    # wall timestamp in OpenMetrics exemplar syntax —
+                    # but on a COMMENT line: neither exposition format
+                    # allows inline exemplars on summary quantiles, and
+                    # a text-0.0.4 scraper must keep parsing (comments
+                    # other than HELP/TYPE are ignored)
+                    ex = v["exemplars"][0]
+                    lines.append(
+                        f"# EXEMPLAR "
+                        f"{name}{_labels_str(ls, ('quantile', '0.99'))} "
+                        f'{{trace_id="{_esc(str(ex["trace_id"]))}"}} '
+                        f'{ex["value"]} {ex["ts"]}')
                 lines.append(f"{name}_sum{_labels_str(ls)} {v['sum']}")
                 lines.append(f"{name}_count{_labels_str(ls)} {v['count']}")
             else:
@@ -123,7 +136,9 @@ def start_metrics_server(port: int, host: str = "127.0.0.1"):
     """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (the full
     snapshot), ``/healthz`` (liveness/readiness probe: 200 only once
     warmup completed and worker threads are live — knn_tpu.obs.health),
-    and ``/statusz`` (the full self-diagnosis report) from a daemon
+    ``/statusz`` (the full self-diagnosis report), and ``/waterfallz``
+    (per-request latency waterfalls + critical-path attribution —
+    knn_tpu.obs.waterfall) from a daemon
     thread; returns the server (``.shutdown()`` to stop;
     ``.server_address[1]`` for the bound port — pass port 0 to let the
     OS pick one)."""
@@ -151,6 +166,15 @@ def start_metrics_server(port: int, host: str = "127.0.0.1"):
                 ctype = "application/json"
             elif path == "/statusz":
                 body = json.dumps(health.report(), indent=1,
+                                  sort_keys=True, default=str).encode()
+                ctype = "application/json"
+            elif path == "/waterfallz":
+                from knn_tpu.obs import waterfall
+
+                # the full forensics payload: every reconstructable
+                # waterfall from the live ring, attribution, and the
+                # slowest-requests table (cli `waterfall --port`)
+                body = json.dumps(waterfall.live_report(), indent=1,
                                   sort_keys=True, default=str).encode()
                 ctype = "application/json"
             else:

@@ -215,11 +215,41 @@ def _tune_cache_status() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def report() -> dict:
+def _slowest_requests() -> list:
+    """The slowest-requests exemplar table with inline waterfalls
+    (knn_tpu.obs.waterfall) — never fatal: a status probe must render
+    even when the forensics layer cannot."""
+    try:
+        from knn_tpu.obs import waterfall
+
+        return waterfall.slowest_table()
+    except Exception as e:  # noqa: BLE001 - introspection must not raise
+        return [{"error": f"{type(e).__name__}: {e}"}]
+
+
+def _postmortems() -> dict:
+    try:
+        from knn_tpu.obs import blackbox
+
+        return blackbox.status()
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def report(slo_section: Optional[dict] = None,
+           slowest: Optional[list] = None) -> dict:
     """The full /statusz payload (see module docstring).  Everything in
-    it is JSON-serializable; ``doctor`` renders the same structure."""
+    it is JSON-serializable; ``doctor`` renders the same structure.
+
+    ``slo_section`` injects an ALREADY-COMPUTED SLO report instead of
+    evaluating a fresh pass — the flight recorder passes the evaluation
+    that fired it, so building a postmortem bundle can never observe
+    (and re-fire on) a second transition mid-dump.  ``slowest``
+    likewise injects a prebuilt slowest-requests table so the bundle
+    path reconstructs the event ring once, not per consumer."""
     pr = probe()
-    slo_section = slo.slo_report()
+    if slo_section is None:
+        slo_section = slo.slo_report()
     alerts = [e for e in trace.get_event_log().recent()
               if e.get("name") == "slo.alert"][-REPORT_ALERTS:]
     engines, queues = _live_components()
@@ -242,6 +272,12 @@ def report() -> dict:
         "active_breaches": (slo_section.get("breached", [])
                             if slo_section else []),
         "alerts": alerts,
+        # tail forensics: the worst recent requests (histogram
+        # exemplars) with inline waterfalls, and the flight recorder's
+        # bundle inventory (knn_tpu.obs.{waterfall,blackbox})
+        "slowest_requests": (_slowest_requests() if slowest is None
+                             else slowest),
+        "postmortems": _postmortems(),
     }
 
 
@@ -270,6 +306,7 @@ def report_from_snapshot(payload: dict) -> dict:
         "engines": [], "queues": [],
         "tune_cache": {}, "roofline": {}, "slo": {},
         "active_breaches": [], "alerts": [],
+        "slowest_requests": [], "postmortems": {},
     }
 
 
@@ -361,4 +398,26 @@ def render_text(rep: dict) -> str:
         for a in alerts:
             lines.append(f"  [{a.get('ts')}] {a.get('objective')} "
                          f"{a.get('state')}")
+    slowest = [r for r in rep.get("slowest_requests") or []
+               if "trace_id" in r]
+    if slowest:
+        lines.append(f"slowest recent request(s) ({len(slowest)}):")
+        from knn_tpu.obs import waterfall as _wf
+
+        for r in slowest:
+            tag = f"  {r.get('latency_ms')} ms  {r.get('trace_id')}"
+            if r.get("tenant") is not None:
+                tag += f"  tenant={r['tenant']}"
+            lines.append(tag)
+            if r.get("waterfall"):
+                for ln in _wf.render_waterfall(r["waterfall"]).splitlines():
+                    lines.append("    " + ln)
+    pm = rep.get("postmortems") or {}
+    if pm.get("dir"):
+        lines.append(f"postmortems: {pm['dir']} "
+                     f"({len(pm.get('bundles') or [])} bundle(s), "
+                     f"keep {pm.get('keep')})")
+        for b in pm.get("bundles") or []:
+            lines.append(f"  {b.get('file')} ({b.get('bytes')} B, "
+                         f"{b.get('modified_at')})")
     return "\n".join(lines) + "\n"

@@ -80,6 +80,9 @@ SLO_EVALUATIONS = "knn_tpu_slo_evaluations_total"
 # --- health introspection (knn_tpu.obs.health) -------------------------
 HEALTH_READY = "knn_tpu_health_ready"
 
+# --- flight recorder (knn_tpu.obs.blackbox) ----------------------------
+POSTMORTEMS_WRITTEN = "knn_tpu_postmortems_written_total"
+
 # --- roofline model (knn_tpu.obs.roofline) -----------------------------
 ROOFLINE_PCT = "knn_tpu_roofline_pct"
 ROOFLINE_CEILING_QPS = "knn_tpu_roofline_ceiling_qps"
@@ -244,6 +247,11 @@ CATALOG = {
         "gauge", (),
         "1 when the readiness probe passes (warmup complete, worker "
         "threads live), 0 otherwise; set on every /healthz or report()."),
+    POSTMORTEMS_WRITTEN: (
+        "counter", ("objective",),
+        "Flight-recorder postmortem bundles written to "
+        "KNN_TPU_POSTMORTEM_DIR, one per edge-triggered SLO breach "
+        "transition, by the objective that fired."),
     ROOFLINE_PCT: (
         "gauge", ("config",),
         "Measured throughput as a fraction of the analytic roofline "

@@ -46,6 +46,14 @@ OBS_ENV = "KNN_TPU_OBS"
 #: bounded histogram window (samples per labeled series)
 DEFAULT_WINDOW = 4096
 
+#: worst-recent exemplars retained per histogram series (trace ids of
+#: the samples that blew the tail — the histogram->trace join)
+EXEMPLAR_CAP = 8
+
+#: an exemplar ages out of the "worst RECENT" store after this long —
+#: yesterday's spike must not pin today's slowest-requests table
+EXEMPLAR_MAX_AGE_S = 600.0
+
 
 class Counter:
     """Monotone counter; ``inc`` only (negative increments refused)."""
@@ -95,10 +103,18 @@ class Gauge:
 
 class Histogram:
     """Lifetime count/sum/min/max + a bounded recent-sample window the
-    percentiles are computed over (see module docstring)."""
+    percentiles are computed over (see module docstring).
+
+    ``observe(value, exemplar=trace_id)`` additionally retains the
+    trace ids of the WORST recent samples (at most :data:`EXEMPLAR_CAP`,
+    aged out after :data:`EXEMPLAR_MAX_AGE_S`) — the histogram->trace
+    join the tail-forensics layer (knn_tpu.obs.waterfall) reads, the
+    Prometheus exporter emits as OpenMetrics-style exemplars, and the
+    slowest-requests tables render.  Call sites without a trace id pay
+    one ``is None`` check and nothing else."""
 
     __slots__ = ("_lock", "_count", "_sum", "_min", "_max", "_window",
-                 "_wts")
+                 "_wts", "_ex")
 
     def __init__(self, window: int = DEFAULT_WINDOW):
         self._lock = threading.Lock()
@@ -112,8 +128,21 @@ class Histogram:
         #: quantile without its span is ambiguous between "the last
         #: second" and "since boot" (the window-vs-lifetime fix)
         self._wts: deque = deque(maxlen=int(window))
+        #: worst recent exemplars, value-descending:
+        #: (value, trace_id, wall ts, monotonic ts)
+        self._ex: list = []
 
-    def observe(self, value: float) -> None:
+    def _note_exemplar(self, v: float, trace_id: str, mono: float) -> None:
+        # caller holds self._lock
+        cutoff = mono - EXEMPLAR_MAX_AGE_S
+        ex = [e for e in self._ex if e[3] >= cutoff]
+        if len(ex) < EXEMPLAR_CAP or v > ex[-1][0]:
+            ex.append((v, str(trace_id), time.time(), mono))
+            ex.sort(key=lambda e: -e[0])
+            del ex[EXEMPLAR_CAP:]
+        self._ex = ex
+
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         v = float(value)
         t = time.monotonic()
         with self._lock:
@@ -125,6 +154,21 @@ class Histogram:
                 self._max = v
             self._window.append(v)
             self._wts.append(t)
+            if exemplar is not None:
+                self._note_exemplar(v, exemplar, t)
+
+    def exemplars(self) -> list:
+        """Worst recent exemplars, value-descending:
+        ``[{"value", "trace_id", "ts"}, ...]`` (``ts`` is wall time).
+        Ages out on READ as well as on write — a series whose traffic
+        stopped must not pin yesterday's spike forever."""
+        cutoff = time.monotonic() - EXEMPLAR_MAX_AGE_S
+        with self._lock:
+            if any(e[3] < cutoff for e in self._ex):
+                self._ex = [e for e in self._ex if e[3] >= cutoff]
+            ex = list(self._ex)
+        return [{"value": v, "trace_id": tid, "ts": round(ts, 3)}
+                for v, tid, ts, _ in ex]
 
     def observe_many(self, values) -> None:
         """Bulk observe (one lock acquisition) — the int8 quant-bound
@@ -161,6 +205,11 @@ class Histogram:
         out: Dict[str, float] = {"count": count, "sum": total}
         if mn is not None:
             out["min"], out["max"] = mn, mx
+        ex = self.exemplars()
+        if ex:
+            # only exemplar-fed series grow the key: summaries of
+            # histograms nobody passes trace ids to are unchanged
+            out["exemplars"] = ex
         if window:
             # numpy only when there are samples: keeps the empty-series
             # snapshot path import-light
@@ -194,11 +243,14 @@ class _Noop:
     def set(self, value: float) -> None:
         pass
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         pass
 
     def observe_many(self, values) -> None:
         pass
+
+    def exemplars(self) -> list:
+        return []
 
     def get(self):
         return 0.0

@@ -310,6 +310,11 @@ class SLOEngine:
         max_span = max((s for _, s in self.windows), default=600.0)
         self._min_sample_gap = max_span / (SAMPLE_RING // 2)
         self._breached: Dict[str, bool] = {}
+        #: firing transitions collected DURING an evaluation pass (under
+        #: the lock) and handed to the flight recorder AFTER it: the
+        #: recorder re-reads health/metrics state whose own code paths
+        #: evaluate SLOs, so invoking it lock-held would deadlock
+        self._fired: list = []
 
     # -- window machinery --------------------------------------------------
     def _ratio_counters(self):
@@ -386,6 +391,16 @@ class SLOEngine:
             if (not self._samples
                     or now - self._samples[-1][0] >= self._min_sample_gap):
                 self._samples.append((now, current))
+            fired, self._fired = self._fired, []
+        # flight recorder OUTSIDE the lock: one bundle per firing
+        # transition (knn_tpu.obs.blackbox; no-op without
+        # KNN_TPU_POSTMORTEM_DIR).  Edge-triggering above guarantees a
+        # still-breached re-evaluation never lands here again.
+        if fired:
+            from knn_tpu.obs import blackbox
+
+            for key, detail in fired:
+                blackbox.on_breach(key, detail, slo_report=report)
         return report
 
     def _eval_grouped(self, o: Objective, samples, current, snap,
@@ -528,6 +543,8 @@ class SLOEngine:
                              objective=key).inc()
             trace.emit_event("slo.alert", objective=key,
                              state="firing", kind=o.kind, **detail)
+            # queue the flight-recorder dump for after the lock drops
+            self._fired.append((key, detail))
         else:
             trace.emit_event("slo.alert", objective=key,
                              state="resolved", kind=o.kind, **detail)

@@ -148,7 +148,9 @@ class PendingSearch:
             # join = time blocked on the device/transfer inside result();
             # the request span is the full submit-to-result wall
             obs.record_span("serving.join", self.trace_id,
-                            done - t_join, op=self._op)
+                            done - t_join, op=self._op,
+                            **({} if self.tenant is None
+                               else {"tenant": self.tenant}))
             self._engine._record_latency(done - self._t0, self._op,
                                          trace_id=self.trace_id,
                                          rows=self._n,
@@ -396,13 +398,20 @@ class ServingEngine:
         t0 = time.perf_counter()
         try:
             with obs.span("serving.dispatch", trace_id=trace_id, op=op,
-                          rows=int(q.shape[0])):
+                          rows=int(q.shape[0]),
+                          **({"tenant": tenant}
+                             if tenant is not None else {})) as sp:
                 chunks = []
                 lo = 0
+                rungs = []
                 for size in split_sizes(q.shape[0], self.buckets[-1]):
+                    rungs.append(int(bucket_for(self.buckets, size)))
                     chunks.append(
                         self._dispatch_chunk(op, q[lo : lo + size], trace_id))
                     lo += size
+                # which ladder rungs this request rode: the waterfall
+                # layer groups its per-bucket attribution off this
+                sp.set("buckets", rungs)
         except Exception:
             self._record_error(op, tenant=tenant)
             raise
@@ -477,13 +486,18 @@ class ServingEngine:
         # stats()["latency_ms"]: every sample feeds both, but each keeps
         # its own bounded percentile window (latency_window here, the
         # registry default there), so quantiles can differ when the
-        # engine was built with a non-default window
-        obs.histogram(mn.SERVING_REQUEST_LATENCY, op=op).observe(seconds)
+        # engine was built with a non-default window.  The exemplar
+        # keeps the worst samples' trace ids joinable back to their
+        # spans (the histogram->trace join the waterfall layer reads).
+        obs.histogram(mn.SERVING_REQUEST_LATENCY, op=op).observe(
+            seconds, exemplar=trace_id)
         if tenant is not None:
             obs.histogram(mn.TENANT_REQUEST_LATENCY,
-                          tenant=tenant).observe(seconds)
+                          tenant=tenant).observe(seconds,
+                                                 exemplar=trace_id)
         obs.record_span("serving.request", trace_id, seconds, op=op,
-                        **({} if rows is None else {"rows": int(rows)}))
+                        **({} if rows is None else {"rows": int(rows)}),
+                        **({} if tenant is None else {"tenant": tenant}))
 
     def _record_error(self, op: str, *,
                       tenant: Optional[str] = None) -> None:
@@ -533,10 +547,24 @@ class ServingEngine:
         tuning_info = self._tuning_info()
         slo_section = (obs.slo_report()
                        if include_slo and obs.enabled() else None)
+        # the slowest-requests exemplar table (trace ids of the worst
+        # recent samples, no inline waterfalls at this altitude —
+        # /statusz carries those).  Present only while telemetry is on:
+        # the disabled stats() shape is part of the obs-off contract.
+        slowest = None
+        if obs.enabled():
+            try:
+                from knn_tpu.obs import waterfall
+
+                slowest = waterfall.slowest_table(with_waterfalls=False)
+            except Exception:  # pragma: no cover - stats must not die
+                slowest = []
         with self._lock:
             return {
                 **({"tuning": tuning_info} if tuning_info else {}),
                 **({"slo": slo_section} if slo_section else {}),
+                **({"slowest_requests": slowest}
+                   if slowest is not None else {}),
                 "buckets": list(self.buckets),
                 "compile_count": int(sum(self._compiles.values())),
                 "executables": len(self._execs),

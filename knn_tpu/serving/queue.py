@@ -193,10 +193,18 @@ class QueryQueue:
                 f"deadline_ms must be > 0, got {deadline_ms}")
         fut: Future = Future()
         tid = obs.new_trace_id()  # THIS request's id, coalescing-proof
+        # the loadgen driver (and any caller) can join this request's
+        # telemetry by id without reaching into queue internals — the
+        # same contract as the dispatch_t stamp below
+        fut.trace_id = tid
+        # arrival is stamped BEFORE the cond: submit-side lock wait is
+        # part of what the caller experiences (it lands in queue_wait,
+        # the admission span below, and the request total — not in a
+        # blind spot between them)
+        now = time.monotonic()
         with self._cond:
             if self._closed:
                 raise RuntimeError("QueryQueue is closed")
-            now = time.monotonic()
             deadline = (None if deadline_ms is None
                         else now + deadline_ms / 1e3)
             prio = 0
@@ -220,6 +228,16 @@ class QueryQueue:
             self._g_depth_req.set(len(self._pending))
             self._g_depth_rows.set(self._pending_rows)
             self._cond.notify_all()
+        if tid is not None:
+            # the admission slice of the request's life (lock wait +
+            # the admit decision).  It runs INSIDE the queue_wait
+            # window (t_arr is stamped before admit), so the waterfall
+            # reconstruction carves it OUT of queue_wait — emitted
+            # separately here precisely so that carve is measurable.
+            obs.record_span(
+                "serving.admission", tid, time.monotonic() - now,
+                rows=int(q.shape[0]),
+                **({"tenant": tenant} if tenant is not None else {}))
         obs.counter(mn.QUEUE_REQUESTS).inc()
         if tenant is not None:
             obs.counter(mn.TENANT_REQUESTS, tenant=tenant).inc()
@@ -418,9 +436,13 @@ class QueryQueue:
                 # gets a fresh batch-level id, linked below
                 t_disp = time.monotonic()
                 for p in batch:
-                    obs.record_span("serving.queue_wait", p.tid,
-                                    t_disp - p.t_arr, rows=int(p.q.shape[0]))
-                    obs.histogram(mn.QUEUE_WAIT).observe(t_disp - p.t_arr)
+                    obs.record_span(
+                        "serving.queue_wait", p.tid, t_disp - p.t_arr,
+                        rows=int(p.q.shape[0]),
+                        **({"tenant": p.tenant}
+                           if p.tenant is not None else {}))
+                    obs.histogram(mn.QUEUE_WAIT).observe(
+                        t_disp - p.t_arr, exemplar=p.tid)
                     # the loadgen driver reads this to record per-request
                     # dispatch time (arrival it already knows)
                     p.fut.dispatch_t = t_disp
@@ -488,15 +510,31 @@ class QueryQueue:
                 self._lat.append((done_t, done_t - p.t_arr))
                 # arrival-to-result under the request's own trace id —
                 # what a caller tuning max_wait_ms actually experiences
+                # (the exemplar keeps the tail's ids joinable to traces)
                 obs.histogram(mn.QUEUE_REQUEST_LATENCY).observe(
-                    done_t - p.t_arr)
+                    done_t - p.t_arr, exemplar=p.tid)
                 if p.tenant is not None:
                     obs.histogram(mn.TENANT_REQUEST_LATENCY,
-                                  tenant=p.tenant).observe(done_t - p.t_arr)
-                obs.record_span("serving.queued_request", p.tid,
-                                done_t - p.t_arr, op=self.op,
-                                rows=int(p.q.shape[0]),
-                                batch_trace_id=handle.trace_id)
+                                  tenant=p.tenant).observe(
+                        done_t - p.t_arr, exemplar=p.tid)
+                if p.tid is not None:
+                    # deliver closes the span chain: batch completion to
+                    # THIS member's future resolution (scatter +
+                    # head-of-line in this loop), so the request's
+                    # segments tile its whole life; the request span
+                    # therefore ends HERE, at delivery, while the
+                    # histograms above keep their historical
+                    # arrival-to-batch-completion semantics
+                    t_res = time.monotonic()
+                    ten = ({"tenant": p.tenant}
+                           if p.tenant is not None else {})
+                    obs.record_span("serving.deliver", p.tid,
+                                    t_res - done_t, **ten)
+                    obs.record_span("serving.queued_request", p.tid,
+                                    t_res - p.t_arr, op=self.op,
+                                    rows=int(p.q.shape[0]),
+                                    batch_trace_id=handle.trace_id,
+                                    **ten)
             self._retire(batch)
 
     def _record_errors(self, batch: List[_Pending]) -> None:

@@ -21,10 +21,13 @@ os.environ["KNN_TPU_TUNE_CACHE"] = os.path.join(
     tempfile.mkdtemp(prefix="knn_tpu_test_tune_"), "autotune.json")
 # isolate the telemetry env knobs the same way: the suite assumes the
 # default-on registry, no ambient JSONL sink, the default rotation cap,
-# and the default SLO objectives (tests that exercise these set their
-# own paths/values explicitly)
+# the default SLO objectives, and a DISARMED flight recorder — an
+# ambient KNN_TPU_POSTMORTEM_DIR would write a postmortem bundle on
+# every test that trips an SLO breach (tests that exercise these set
+# their own paths/values explicitly)
 for _knob in ("KNN_TPU_OBS", "KNN_TPU_OBS_LOG",
-              "KNN_TPU_OBS_LOG_MAX_BYTES", "KNN_TPU_SLO_CONFIG"):
+              "KNN_TPU_OBS_LOG_MAX_BYTES", "KNN_TPU_SLO_CONFIG",
+              "KNN_TPU_POSTMORTEM_DIR", "KNN_TPU_POSTMORTEM_KEEP"):
     os.environ.pop(_knob, None)
 # isolate the admission-control and loadgen knobs: a developer shell's
 # ambient KNN_TPU_ADMISSION_* would silently flip every QueryQueue in

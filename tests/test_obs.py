@@ -401,7 +401,8 @@ def test_int8_quant_bound_distribution_recorded(rng):
     assert s["min"] == pytest.approx(float(np.min(eps)))
 
 
-def test_results_bitwise_identical_obs_on_vs_off(placed):
+def test_results_bitwise_identical_obs_on_vs_off(placed, tmp_path,
+                                                 monkeypatch):
     prog, rng = placed
     q = rng.standard_normal((8, 16)).astype(np.float32)
     d_on, i_on, _ = prog.search_certified(q, selector="approx", margin=8)
@@ -411,6 +412,19 @@ def test_results_bitwise_identical_obs_on_vs_off(placed):
     # is bitwise identical
     np.testing.assert_array_equal(i_on, i_off)
     np.testing.assert_array_equal(d_on, d_off)
+    # ...and no tail-forensics work happens either: exemplars are the
+    # shared no-op, reconstruction has nothing to read, and the flight
+    # recorder stays disarmed even with a destination configured
+    from knn_tpu.obs import blackbox, waterfall
+
+    h = obs.histogram(mn.SERVING_REQUEST_LATENCY, op="search")
+    h.observe(1.0, exemplar="feed000000000001")
+    assert h.exemplars() == []
+    assert waterfall.slowest_table() == []
+    monkeypatch.setenv(blackbox.DIR_ENV, str(tmp_path / "pm"))
+    assert not blackbox.enabled()
+    assert blackbox.on_breach("serving_availability", {}) is None
+    assert not (tmp_path / "pm").exists()
 
 
 # --- tuning counters -----------------------------------------------------
