@@ -1317,8 +1317,15 @@ def main() -> None:
 
     # the chip's own rate, net of the harness's host<->device relay —
     # surfaced top-level because on the dev harness the relay, not the
-    # TPU, binds the end-to-end number
-    dev_qps = (results.get("certified_pallas", {})
+    # TPU, binds the end-to-end number.  Hoisted from the WINNING
+    # mode's phase breakdown (every selector carries one since r4), so
+    # the sentinel's device_phase_qps baseline judges device-phase
+    # regressions separately from end-to-end qps on every line — not
+    # only when certified_pallas wins; the pallas breakdown remains the
+    # fallback for lines whose winner has no device probe
+    dev_qps = (results.get(best, {})
+               .get("phase_breakdown", {}).get("device_qps")
+               or results.get("certified_pallas", {})
                .get("phase_breakdown", {}).get("device_qps"))
     # the pointer applies to any relay-down FALLBACK run (backend fell
     # to cpu without being asked for), shrunken or not — explicit env

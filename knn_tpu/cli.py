@@ -399,7 +399,7 @@ def build_roofline_parser() -> argparse.ArgumentParser:
                             "default"),
                    help="kernel matmul precision (pallas selector)")
     p.add_argument("--kernel", default=None,
-                   choices=("tiled", "streaming"))
+                   choices=("tiled", "streaming", "fused"))
     p.add_argument("--grid-order", default=None,
                    choices=("query_major", "db_major"))
     p.add_argument("--binning", default=None, choices=("grouped", "lane"))
@@ -417,10 +417,87 @@ def build_roofline_parser() -> argparse.ArgumentParser:
     p.add_argument("--qps", type=float, default=None,
                    help="a measured q/s to attribute: adds "
                    "roofline_pct to the output")
+    p.add_argument("--best", nargs="?", const=10, type=int, default=None,
+                   metavar="N",
+                   help="rank the FULL autotuner knob grid by modeled "
+                   "ceiling for (n, dim, k, device kind) and print the "
+                   "top N configs with their bound class — the offline "
+                   "twin of the autotuner's roofline pruning "
+                   "(KNN_TPU_TUNE_PRUNE); knob flags above are ignored")
     p.add_argument("--json", action="store_true",
                    help="print the raw model JSON instead of the "
                    "human-readable rendering")
     return p
+
+
+def _run_roofline_best(args) -> int:
+    """``cli roofline --best``: the full autotuner knob grid
+    (knn_tpu.tuning.knob_grid("full")) ranked by modeled ceiling —
+    what the in-tune pruning consults, runnable offline for planning
+    ("which configs are even worth chip time on this device kind?").
+    jax-free like the rest of the subcommand."""
+    import json
+
+    from knn_tpu import tuning
+    from knn_tpu.obs import roofline
+    from knn_tpu.tuning.autotune import _label
+
+    ranked = []
+    seen = set()
+    for cand in tuning.knob_grid("full"):
+        knobs = {**tuning.DEFAULT_KNOBS, **cand}
+        # final_select/final_recall_target don't enter the cost model:
+        # dedupe to the model-relevant knob tuple so each geometry
+        # prints once
+        mkey = (knobs["precision"], knobs["kernel"], knobs["grid_order"],
+                knobs["binning"], knobs["tile_n"], knobs["block_q"],
+                knobs["survivors"])
+        if mkey in seen:
+            continue
+        seen.add(mkey)
+        try:
+            model = roofline.pallas_cost_model(
+                n=args.n, d=args.dim, k=args.k, nq=args.nq,
+                precision=knobs["precision"], kernel=knobs["kernel"],
+                grid_order=knobs["grid_order"], binning=knobs["binning"],
+                tile_n=knobs["tile_n"], block_q=knobs["block_q"],
+                survivors=knobs["survivors"], margin=args.margin,
+                device_kind=args.device_kind, num_devices=args.devices)
+        except ValueError:
+            continue  # a combination the model refuses
+        if not model.get("ceiling_qps"):
+            continue
+        ranked.append({
+            "config": _label(knobs),
+            "ceiling_qps": model["ceiling_qps"],
+            "bound_class": model["bound_class"],
+            "select_overlapped": model["select_overlapped"],
+            "estimated": model["estimated"],
+        })
+    ranked.sort(key=lambda r: -r["ceiling_qps"])
+    top = ranked[: max(1, int(args.best))]
+    payload = {
+        "best": top,
+        "modeled": len(ranked),
+        "model_version": roofline.MODEL_VERSION,
+    }
+    if args.json:
+        # honor the subcommand's --json contract: ONE JSON document on
+        # stdout, nothing else
+        print(json.dumps(payload, indent=1, sort_keys=True))
+        return 0
+    est = " (ESTIMATED generic fallback peaks)" if top and \
+        top[0]["estimated"] else ""
+    print(f"top {len(top)} of {len(ranked)} modeled configs for "
+          f"n={args.n} d={args.dim} k={args.k} nq={args.nq} on "
+          f"{args.device_kind or 'generic-cpu'}{est}  "
+          f"[roofline v{roofline.MODEL_VERSION}]")
+    for rank, rec in enumerate(top, 1):
+        tag = " +overlap" if rec["select_overlapped"] else ""
+        print(f"  {rank:2d}. {rec['ceiling_qps']:>12,.0f} q/s  "
+              f"{rec['bound_class']:<17}{tag:<9} {rec['config']}")
+    print(json.dumps(payload))
+    return 0
 
 
 def run_roofline(args: argparse.Namespace) -> int:
@@ -431,6 +508,8 @@ def run_roofline(args: argparse.Namespace) -> int:
 
     from knn_tpu.obs import roofline
 
+    if args.best is not None:
+        return _run_roofline_best(args)
     if args.selector == "pallas":
         model = roofline.pallas_cost_model(
             n=args.n, d=args.dim, k=args.k, nq=args.nq,

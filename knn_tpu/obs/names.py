@@ -61,6 +61,10 @@ TUNING_CACHE_MISSES = "knn_tpu_tuning_cache_misses_total"
 TUNING_SEARCHES = "knn_tpu_tuning_searches_total"
 TUNING_CANDIDATES_TIMED = "knn_tpu_tuning_candidates_timed_total"
 TUNING_GATE_FAILURES = "knn_tpu_tuning_gate_failures_total"
+TUNING_CANDIDATES_PRUNED = "knn_tpu_tuning_candidates_pruned_total"
+
+# --- certified pipeline overlap (knn_tpu.parallel.sharded) -------------
+PIPELINE_OVERLAP_RATIO = "knn_tpu_pipeline_overlap_ratio"
 
 # --- JAX compile events (knn_tpu.obs.jax_hooks) ------------------------
 JAX_COMPILES = "knn_tpu_jax_compiles_total"
@@ -211,6 +215,16 @@ CATALOG = {
     TUNING_GATE_FAILURES: (
         "counter", (), "Autotuner candidates rejected by the bitwise "
         "end-result gate."),
+    TUNING_CANDIDATES_PRUNED: (
+        "counter", (), "Autotuner candidates skipped before timing by "
+        "the roofline-model pruning gate (KNN_TPU_TUNE_PRUNE; every "
+        "skip is recorded in the tune entry's pruning provenance)."),
+    PIPELINE_OVERLAP_RATIO: (
+        "gauge", (),
+        "Fraction of the last certified pipeline-overlap run's wall "
+        "time with >= 2 batches in flight (coarse-dispatch start to "
+        "result-repair end) — the two-stage coarse/rescore pipeline's "
+        "measured dispatch-timeline overlap."),
     JAX_COMPILES: (
         "counter", ("event",),
         "JAX/XLA compile events observed via jax.monitoring."),

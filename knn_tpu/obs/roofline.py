@@ -26,17 +26,23 @@ term by term and jax-free:
 
 Each term divides by the device's peak (``PEAKS_BY_KIND`` — the single
 source of truth ``bench.py``'s ``_PEAK_BY_KIND`` is now a view over)
-to a time; the slowest term names the ceiling::
+to a time; the largest term names the bound class, and the combined
+time reflects whether the select can hide in the stream's shadow::
 
-    ceiling_qps = nq / max(t_hbm, t_mxu, t_vpu)
+    non-fused / XLA:  ceiling_qps = nq / (max(t_hbm, t_mxu) + t_vpu)
+    kernel="fused":   ceiling_qps = nq / max(t_hbm, t_mxu, t_vpu)
     bound_class in {"hbm_bound", "mxu_bound", "vpu_select_bound"}
     roofline_pct = measured_qps / ceiling_qps
 
-The ceiling assumes perfect phase overlap and peak-rate execution of
-every term, so ``roofline_pct <= 1`` up to peak-table error — a pct
-near 1 means the config is done and the *model's* bound must move
-(different precision, grid order, geometry); a low pct names
-implementation slack.  Everything here is pure arithmetic on plain
+The distance matmul overlaps the db stream in every kernel (that IS
+the double buffer), but the select runs AFTER each tile's scores
+exist — serialized — except in the fused kernel, whose in-loop
+carry/early-out select rides the HBM stream (``select_overlapped`` on
+the block says which formula applied; MODEL_VERSION 2).  The ceiling
+assumes peak-rate execution of every term, so ``roofline_pct <= 1`` up
+to peak-table error — a pct near 1 means the config is done and the
+*model's* bound must move (different precision, grid order, geometry);
+a low pct names implementation slack.  Everything here is pure arithmetic on plain
 numbers: the bench, the artifact refresher, the sentinel lint, and the
 ``cli roofline`` subcommand all run it without importing JAX.
 
@@ -55,8 +61,14 @@ from knn_tpu.obs import names, registry, trace
 #: bump when the model's terms/peaks/output schema change: the tuning
 #: cache embeds this in its key (tuning.cache.roofline_token), so
 #: persisted winners carrying attributions from an older model
-#: self-invalidate instead of republishing a stale verdict
-MODEL_VERSION = 1
+#: self-invalidate instead of republishing a stale verdict.
+#: 2 = the select-overlap refinement: non-fused kernels SERIALIZE the
+#: select after the stream (``max(t_hbm, t_mxu) + t_vpu``); the fused
+#: kernel rides the select in the HBM stream's shadow
+#: (``max(t_hbm, t_mxu, t_vpu)``) — so the fused int8/streaming arm's
+#: modeled ceiling rises above the non-fused one, which is exactly the
+#: gap the in-kernel fused select exists to close.
+MODEL_VERSION = 2
 
 #: the three resources a config can exhaust, in tie-break order
 BOUND_CLASSES = ("hbm_bound", "mxu_bound", "vpu_select_bound")
@@ -163,6 +175,11 @@ BLOCK_Q_DEFAULT = 128
 BIN_W = 128
 SURVIVORS_GROUPED_DEFAULT = 2
 DIM_CHUNK = 128
+#: mirror of ops.pallas_knn.MAX_CARRY_DEPTH (pinned by the same test):
+#: past ceil((k+margin+2)/128) carry stats per lane the fused kernel
+#: DISARMS its early-out and runs the plain serialized streaming path,
+#: so the model must stop granting those configs the overlapped ceiling
+MAX_CARRY_DEPTH = 8
 
 #: matmul dtype widths for the XLA (non-pallas) selectors
 _DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float64": 8}
@@ -219,9 +236,15 @@ def db_operand_nbytes(n: int, d: int, precision: str) -> Dict[str, int]:
     }
 
 
-def _terms_to_verdict(model: dict, nq: int) -> None:
-    """Fill ceiling_qps + bound_class from the per-term times (slowest
-    term is the roofline; ties break in BOUND_CLASSES order)."""
+def _terms_to_verdict(model: dict, nq: int,
+                      select_overlapped: bool = False) -> None:
+    """Fill ceiling_qps + bound_class from the per-term times.  The
+    bound class is the largest term (ties break in BOUND_CLASSES
+    order); the ceiling's combined time depends on whether the select
+    overlaps the stream: non-fused kernels and the XLA selectors run
+    the select AFTER the streamed scores exist —
+    ``max(t_hbm, t_mxu) + t_vpu`` — while the fused kernel's in-loop
+    select rides the HBM stream's shadow, ``max`` of all three."""
     terms = model["terms"]
     times = {
         "hbm_bound": terms["hbm"]["time_s"],
@@ -229,8 +252,13 @@ def _terms_to_verdict(model: dict, nq: int) -> None:
         "vpu_select_bound": terms["vpu_select"]["time_s"],
     }
     bound = max(BOUND_CLASSES, key=lambda c: (times[c], -BOUND_CLASSES.index(c)))
-    t = times[bound]
+    if select_overlapped:
+        t = max(times.values())
+    else:
+        t = max(times["hbm_bound"], times["mxu_bound"]) + \
+            times["vpu_select_bound"]
     model["bound_class"] = bound
+    model["select_overlapped"] = bool(select_overlapped)
     model["ceiling_qps"] = round(nq / t, 1) if t > 0 else None
     model["term_times_s"] = {k: round(v, 6) for k, v in times.items()}
 
@@ -252,6 +280,9 @@ def pallas_cost_model(
     parallel."""
     precision = precision or "bf16x3"
     kernel = kernel or "tiled"
+    if kernel not in ("tiled", "streaming", "fused"):
+        raise ValueError(
+            f"kernel {kernel!r} not in ('tiled', 'streaming', 'fused')")
     grid_order = grid_order or "query_major"
     binning = binning or "grouped"
     tile = int(tile_n or TILE_N_DEFAULT)
@@ -278,10 +309,10 @@ def pallas_cost_model(
 
     # --- HBM bytes ------------------------------------------------------
     # db stream passes: query_major (and the inherently query-major
-    # streaming kernel) re-stream the full db once per query block;
-    # db_major streams it ONCE at single-chunk dims but degenerates to
-    # query_major traffic when the innermost chunk axis cycles between
-    # query blocks (ops.pallas_knn.GRID_ORDERS)
+    # streaming/fused kernels) re-stream the full db once per query
+    # block; db_major streams it ONCE at single-chunk dims but
+    # degenerates to query_major traffic when the innermost chunk axis
+    # cycles between query blocks (ops.pallas_knn.GRID_ORDERS)
     if grid_order == "db_major" and d <= DIM_CHUNK and kernel == "tiled":
         db_passes = 1
     else:
@@ -353,7 +384,20 @@ def pallas_cost_model(
             },
         },
     }
-    _terms_to_verdict(model, nq)
+    # the fused kernel's in-loop select rides the HBM stream's shadow
+    # (its early-out makes the 12-op calibration an upper bound there —
+    # skipped tiles pay ~1 op/elem, unmodelable statically); the
+    # non-fused kernels run the select serially after each tile's
+    # scores exist.  A fused config whose carry would exceed
+    # MAX_CARRY_DEPTH (keep = k+margin+2 past 128*8) DISARMS in the
+    # kernel and runs serialized — the model mirrors that, so the
+    # pruning gate and `--best` can never rank a disarmed config
+    # against a ceiling it cannot reach (the kernel's m-cap can only
+    # shrink keep below this estimate, making the disarm call here
+    # conservative, never optimistic)
+    fused_armed = kernel == "fused" and _ceil_div(
+        int(k) + int(margin) + 2, BIN_W) <= MAX_CARRY_DEPTH
+    _terms_to_verdict(model, nq, select_overlapped=fused_armed)
     return model
 
 
@@ -654,8 +698,10 @@ def render_text(block: dict) -> str:
         f"-> {vp.get('time_s', 0) * 1e3:9.3f} ms   "
         f"({vp.get('ops_per_elem')} ops/elem at "
         f"{vp.get('rate_ops', 0) / 1e12:.1f} Tops/s)")
+    overlap = (" select overlapped" if block.get("select_overlapped")
+               else "")
     lines.append(f"ceiling: {block.get('ceiling_qps')} q/s "
-                 f"({block.get('bound_class')})")
+                 f"({block.get('bound_class')}{overlap})")
     if block.get("roofline_pct") is not None:
         lines.append(f"measured: {block.get('measured_qps')} q/s = "
                      f"{block['roofline_pct'] * 100:.1f}% of roofline")

@@ -97,9 +97,12 @@ CURATED_FIELDS: Tuple[Tuple[str, str], ...] = (
 
 def curated_value(rec: dict, fname: str):
     """One curated field off a history line: top-level first (bench
-    hoists ``roofline_pct``/``knee_qps`` there), falling back into the
-    line's ``roofline``/``loadgen_knee`` block for lines curated
-    before the hoist."""
+    hoists ``roofline_pct``/``knee_qps``/``device_phase_qps`` there),
+    falling back into the line's ``roofline``/``loadgen_knee`` block —
+    or, for ``device_phase_qps``, the winning selector's
+    ``phase_breakdown.device_qps`` — for lines curated before the
+    hoist (bench hoisted the device rate only off certified_pallas
+    wins until the winning-mode hoist)."""
     v = rec.get(fname)
     if v is None and fname == "roofline_pct":
         block = rec.get("roofline")
@@ -109,6 +112,14 @@ def curated_value(rec: dict, fname: str):
         block = rec.get("loadgen_knee")
         if isinstance(block, dict):
             v = block.get("knee_qps")
+    if v is None and fname == "device_phase_qps":
+        sel = rec.get("selectors")
+        if isinstance(sel, dict):
+            entry = sel.get(rec.get("mode"))
+            if isinstance(entry, dict):
+                pb = entry.get("phase_breakdown")
+                if isinstance(pb, dict):
+                    v = pb.get("device_qps")
     return v
 
 #: verdict severity order (worst wins the overall verdict)

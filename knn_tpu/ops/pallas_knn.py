@@ -164,8 +164,11 @@ PRECISIONS = ("bf16x3", "bf16x3f", "int8", "highest", "default")
 #: emitters, or the knob semantics change — the autotuner's persisted
 #: winner cache keys on it (tuning.cache.cache_key), so winners measured
 #: against older kernel code self-invalidate instead of silently steering
-#: a changed kernel.  3 = int8 emitter path added (PR 3).
-KERNEL_VERSION = 3
+#: a changed kernel.  3 = int8 emitter path added (PR 3); 4 = fused
+#: in-loop select arm + the r05-proven block_q=256 default promotion
+#: (tuning.DEFAULT_KNOBS) — old winners measured against block_q=128
+#: reference runs self-invalidate.
+KERNEL_VERSION = 4
 
 #: relative slack of the device rank stage's direct-difference f32
 #: distances: per-term (q-t)^2 rounding plus the depth-7 tree reduce give
@@ -215,19 +218,40 @@ GRID_ORDERS = ("query_major", "db_major")
 #: grid pipeline re-launches the body per train tile; "streaming" = one
 #: launch per (batch, shard) with explicit double-buffered HBM->VMEM
 #: async copies and the candidate list carried in VMEM across tiles.
-KERNELS = ("tiled", "streaming")
+#: "fused" = the streaming launch with the select fused DEEPER into the
+#: tile loop: each tile's per-lane minima are reduced against a
+#: VMEM-resident carry of running order statistics, and a SOUND
+#: exclusion-bound early-out skips a tile's whole select chain when its
+#: best possible score provably cannot enter the final top-(m+2) nor
+#: lower the exclusion bound — the select cost rides the HBM stream's
+#: shadow instead of following it (the `vpu_select_bound` attack named
+#: by the PR 6 roofline model).  Final certified results are
+#: bitwise-identical to the tiled reference: a skipped tile's candidate
+#: block pads with +inf/sentinel, and the skip predicate (strict
+#: tile-min > carry threshold, threshold an upper bound on the final
+#: (m+2)-th smallest EMITTED candidate) guarantees neither the final
+#: select, its tie-breaks, nor the exclusion bound can see the
+#: difference (tests/test_fused_overlap.py).  Grouped binning +
+#: query-major only, like streaming.
+KERNELS = ("tiled", "streaming", "fused")
+
+#: early-out carry depth cap: the threshold needs ceil(min_keep / 128)
+#: running order statistics per lane; deeper carries unroll more
+#: insertion steps per tile, so past this depth the early-out disarms
+#: (thr stays +inf) rather than bloating the kernel trace
+MAX_CARRY_DEPTH = 8
 
 
 def kernel_launches_per_batch(kernel: str, rows: int, tile_n: int) -> int:
     """Db-streaming kernel dispatches per (batch, shard) — the number
     the bench publishes so launch accounting has ONE home: the tiled
     grid re-launches its pipelined body once per train tile; the
-    streaming kernel is ONE launch whose in-kernel loop covers every
-    tile."""
+    streaming/fused kernels are ONE launch whose in-kernel loop covers
+    every tile."""
     if kernel not in KERNELS:
         raise ValueError(f"kernel {kernel!r} not in {KERNELS}")
     n_tiles = -(-rows // tile_n)
-    return 1 if kernel == "streaming" else n_tiles
+    return 1 if kernel in ("streaming", "fused") else n_tiles
 
 
 def _geometry(
@@ -463,6 +487,21 @@ def _emit_select_grouped(ti, qt, tn, *,
     parity with the lane-mode emitter."""
     del bin_w, n_bins  # grouped mode: 128 bins of tile_n // 128 members
     s = tn[0:1, :] - 2.0 * qt  # [BQ, T], ||q||^2 dropped
+    return _emit_select_grouped_scores(
+        ti, s, tile_n=tile_n, survivors=survivors, out_w=out_w,
+        bound_w=bound_w)
+
+
+def _emit_select_grouped_scores(ti, s, *, tile_n: int, survivors: int,
+                                out_w: int, bound_w: int):
+    """The grouped emitter on a PRECOMPUTED score tile ``s`` — split out
+    so the fused kernel (which needs ``s`` for its early-out predicate
+    before deciding whether to run the select at all) shares the EXACT
+    ops with the tiled/streaming paths: ``_emit_select_grouped`` computes
+    ``s = tn[0:1, :] - 2.0 * qt`` and delegates here, the fused tile
+    body computes the identical expression and calls this directly —
+    one arithmetic, bitwise-identical emissions."""
+    del bound_w  # grouped bounds are one [BQ, 128] block
     bq = s.shape[0]
     n_groups = tile_n // BIN_W
     lane = lax.broadcasted_iota(jnp.int32, (bq, BIN_W), 1)
@@ -494,7 +533,8 @@ def _emit_select_grouped(ti, qt, tn, *,
 def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
                    survivors: int, out_w: int, bound_w: int, n_tiles: int,
                    nd: int, precision: str, binning: str, n_parts: int,
-                   chunk_w: int, aux_rows: int = 8):
+                   chunk_w: int, aux_rows: int = 8, fused: bool = False,
+                   keep: Optional[int] = None):
     """One launch per (batch, shard): the db-side arrays stay in HBM and
     stream tile-by-tile through TWO VMEM scratch slots via explicit
     async copies — tile i+1's HBM->VMEM copy overlaps tile i's MXU
@@ -589,6 +629,16 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
     start_parts(0, 0, 0)
     tn_dma(0, 0).start()
 
+    # fused arm: the early-out carry is ceil(keep / 128) running order
+    # statistics per lane of the emitted per-tile lane minima; armed
+    # only when the depth stays inside MAX_CARRY_DEPTH (a deeper carry
+    # unrolls more insertion steps per tile than the select it skips)
+    depth = 0
+    if fused and keep is not None:
+        depth = -(-int(keep) // BIN_W)
+    armed = fused and 0 < depth <= MAX_CARRY_DEPTH
+    bq = q.shape[0]
+
     def tile_body(ti, carry):
         qt = None
         for c in range(nd):  # nd is static: the chunk loop unrolls
@@ -614,18 +664,74 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
             # the one f32 rescale, same op sequence as the tiled write()
             qt = ((qt.astype(jnp.float32) * qsc_ref[:, 0:1])
                   * tn_buf[ti % 2][8:9, :])
-        cd, ci, bound = emit(
-            ti, qt, tn_buf[ti % 2], tile_n=tile_n, bin_w=bin_w,
-            n_bins=n_bins, survivors=survivors, out_w=out_w,
-            bound_w=bound_w)
         off = pl.multiple_of(ti * out_w, out_w)
-        d_ref[:, pl.ds(off, out_w)] = cd
-        i_ref[:, pl.ds(off, out_w)] = ci
         boff = pl.multiple_of(ti * bound_w, bound_w)
-        b_ref[:, pl.ds(boff, bound_w)] = bound
-        return carry
+        if not armed:
+            cd, ci, bound = emit(
+                ti, qt, tn_buf[ti % 2], tile_n=tile_n, bin_w=bin_w,
+                n_bins=n_bins, survivors=survivors, out_w=out_w,
+                bound_w=bound_w)
+            d_ref[:, pl.ds(off, out_w)] = cd
+            i_ref[:, pl.ds(off, out_w)] = ci
+            b_ref[:, pl.ds(boff, bound_w)] = bound
+            return carry
 
-    lax.fori_loop(0, n_tiles, tile_body, 0)
+        # ---- fused early-out path (grouped binning only) --------------
+        # the SAME score expression the grouped emitter computes — the
+        # bitwise contract of the non-skipped tiles rests on this
+        s = tn_buf[ti % 2][0:1, :] - 2.0 * qt  # [BQ, T]
+        n_groups = tile_n // BIN_W
+        lane_min = s[:, 0:BIN_W]
+        for g in range(1, n_groups):
+            lane_min = jnp.minimum(lane_min,
+                                   s[:, g * BIN_W : (g + 1) * BIN_W])
+        # threshold: with every lane holding `depth` carry stats <= thr,
+        # at least 128*depth >= keep emitted candidates score <= thr, so
+        # the final keep-th smallest emitted value is <= thr — a tile
+        # whose WHOLE score block is strictly above thr (for every query
+        # row of the block) can neither place a candidate in the final
+        # top-keep nor lower the exclusion bound below the keep-th value
+        thr = jnp.max(carry[depth - 1], axis=-1)  # [BQ]
+        tile_min = jnp.min(lane_min, axis=-1)     # [BQ]
+        skip = jnp.all(tile_min > thr)
+
+        @pl.when(jnp.logical_not(skip))
+        def _select():
+            cd, ci, bound = _emit_select_grouped_scores(
+                ti, s, tile_n=tile_n, survivors=survivors, out_w=out_w,
+                bound_w=bound_w)
+            d_ref[:, pl.ds(off, out_w)] = cd
+            i_ref[:, pl.ds(off, out_w)] = ci
+            b_ref[:, pl.ds(boff, bound_w)] = bound
+
+        @pl.when(skip)
+        def _pad():
+            # a skipped tile's blocks pad exactly like kernel padding:
+            # +inf candidates / sentinel indices lose every final
+            # select, +inf bounds never bind — and by the predicate no
+            # real value here could have either (strictly above thr)
+            d_ref[:, pl.ds(off, out_w)] = jnp.full(
+                (bq, out_w), jnp.inf, jnp.float32)
+            i_ref[:, pl.ds(off, out_w)] = jnp.full(
+                (bq, out_w), _I32MAX, jnp.int32)
+            b_ref[:, pl.ds(boff, bound_w)] = jnp.full(
+                (bq, bound_w), jnp.inf, jnp.float32)
+
+        # carry update: insert this tile's per-lane minima (each IS an
+        # emitted candidate — the lane's first survivor) into the sorted
+        # per-lane stats.  Unconditional on purpose: a SKIPPED tile's
+        # lane minima all exceed thr >= every carry stat, so insertion
+        # is a provable no-op there — cheaper than a conditional carry
+        cur = lane_min
+        new = []
+        for j in range(depth):
+            new.append(jnp.minimum(carry[j], cur))
+            cur = jnp.maximum(carry[j], cur)
+        return tuple(new)
+
+    init = (tuple(jnp.full((bq, BIN_W), jnp.inf, jnp.float32)
+                  for _ in range(depth)) if armed else 0)
+    lax.fori_loop(0, n_tiles, tile_body, init)
 
 
 def _compiler_params(**kwargs):
@@ -651,7 +757,7 @@ def _on_tpu() -> bool:
 @functools.partial(
     jax.jit, static_argnames=("block_q", "tile_n", "bin_w", "survivors",
                               "precision", "interpret", "binning",
-                              "grid_order", "kernel", "offset")
+                              "grid_order", "kernel", "offset", "keep")
 )
 def _bin_candidates(
     queries: jax.Array,
@@ -668,6 +774,7 @@ def _bin_candidates(
     kernel: str = "tiled",
     db_int8: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     offset: float = 0.0,
+    keep: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Kernel launch on padded shapes.  Returns
 
@@ -707,13 +814,21 @@ def _bin_candidates(
         raise ValueError(f"grid_order {grid_order!r} not in {GRID_ORDERS}")
     if kernel not in KERNELS:
         raise ValueError(f"kernel {kernel!r} not in {KERNELS}")
-    if kernel == "streaming" and grid_order != "query_major":
-        # the streaming launch has no db grid axis to reorder: its tile
-        # loop is inherently query-major.  Refuse rather than silently
-        # ignore the knob (the autotuner enumerates valid combinations).
+    if kernel in ("streaming", "fused") and grid_order != "query_major":
+        # the streaming/fused launches have no db grid axis to reorder:
+        # their tile loop is inherently query-major.  Refuse rather than
+        # silently ignore the knob (the autotuner enumerates valid
+        # combinations).
         raise ValueError(
-            "kernel='streaming' streams the db inside one launch; "
-            "grid_order='db_major' does not apply")
+            f"kernel={kernel!r} streams the db inside one launch; "
+            f"grid_order='db_major' does not apply")
+    if kernel == "fused" and binning != "grouped":
+        # the early-out carry is a per-LANE order-statistic network —
+        # it has no lane-binning analogue (the lane select's cross-lane
+        # shuffles are what grouped exists to avoid in the first place)
+        raise ValueError(
+            "kernel='fused' requires binning='grouped' (the early-out "
+            "carry is per-lane)")
     queries_in = queries
     q_extra = []  # int8: the per-query-row scale block rides as an input
     aux_rows = 8
@@ -784,7 +899,7 @@ def _bin_candidates(
         jax.ShapeDtypeStruct((qp, n_tiles * bound_w), jnp.float32),
     ]
 
-    if kernel == "streaming":
+    if kernel in ("streaming", "fused"):
         return _stream_call(
             queries_in, db_inputs, tnorm, out_shape, qp=qp, dim=dim,
             block_q=block_q, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
@@ -792,6 +907,7 @@ def _bin_candidates(
             n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
             chunk_w=chunk_w, interpret=interpret,
             q_extra=q_extra, aux_rows=aux_rows,
+            fused=kernel == "fused", keep=keep,
         )
 
     db_major = grid_order == "db_major"
@@ -869,19 +985,22 @@ def _bin_candidates(
 def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
                  tile_n, bin_w, n_bins, survivors, out_w, bound_w, n_tiles,
                  nd, precision, binning, chunk_w, interpret,
-                 q_extra=(), aux_rows=8):
+                 q_extra=(), aux_rows=8, fused=False, keep=None):
     """The streaming ``pallas_call``: grid over query blocks only, db
     parts + row norms left in compiler-chosen (HBM) memory and streamed
     by the kernel's own double-buffered DMA loop (``_stream_kernel``).
     ``q_extra`` carries the int8 query-scale block (a small VMEM input
     alongside the query block); ``aux_rows`` is 16 when the aux array
-    stacks scales under norms (int8), else 8."""
+    stacks scales under norms (int8), else 8.  ``fused`` arms the
+    in-loop carry + exclusion-bound early-out (kernel="fused"); ``keep``
+    sizes its carry (the final select's m+2)."""
     n_parts = len(db_inputs)
     body = functools.partial(
         _stream_kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
         survivors=survivors, out_w=out_w, bound_w=bound_w,
         n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
         n_parts=n_parts, chunk_w=chunk_w, aux_rows=aux_rows,
+        fused=fused, keep=keep,
     )
     any_space = getattr(pltpu, "ANY", None) or pltpu.TPUMemorySpace.ANY
     part_dtype = db_inputs[0].dtype
@@ -990,6 +1109,67 @@ def local_certified_candidates(
     candidates surface, never what their distances read."""
     if interpret is None:
         interpret = not _on_tpu()
+    cd, ci, bounds = local_coarse_candidates(
+        q, t, m, tile_n=tile_n, block_q=block_q, bin_w=bin_w,
+        survivors=survivors, precision=precision, interpret=interpret,
+        binning=binning, final_select=final_select,
+        grid_order=grid_order, kernel=kernel, db_int8=db_int8,
+        offset=offset,
+    )
+    return local_select_rescore(
+        q, t, cd, ci, bounds, m, final_select=final_select,
+        final_recall_target=final_recall_target,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("m", "tile_n", "block_q", "bin_w", "survivors",
+                     "precision", "interpret", "binning", "final_select",
+                     "grid_order", "kernel", "offset"),
+)
+def local_coarse_candidates(
+    q: jax.Array,
+    t: jax.Array,
+    m: int,
+    *,
+    tile_n: int = TILE_N,
+    block_q: int = BLOCK_Q,
+    bin_w: int = BIN_W,
+    survivors: Optional[int] = None,
+    precision: str = "bf16x3",
+    interpret: Optional[bool] = None,
+    binning: str = "grouped",
+    grid_order: str = "query_major",
+    kernel: str = "tiled",
+    db_int8: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    offset: float = 0.0,
+    final_select: str = "exact",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Stage 1 of :func:`local_certified_candidates` — the db-streaming
+    coarse pass alone: resolve the effective tile, launch the kernel,
+    trim the query padding.  Returns the packed candidates
+    ``(cd [Q, W], ci [Q, W], bounds [Q, T*B])`` at the boundary the
+    pipeline-overlap path splits the certified program on
+    (parallel.sharded._pallas_coarse_program): stage 2
+    (:func:`local_select_rescore`) is everything after the kernel, so
+    running the two stages back to back IS the one-shot function —
+    bitwise, by construction."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    if final_select not in ("exact", "approx"):
+        raise ValueError(
+            f"final_select {final_select!r} not in ('exact', 'approx')")
+    if kernel == "fused" and final_select == "approx":
+        # the early-out's bitwise argument rests on the EXACT top-(m+2)
+        # boundary: every skipped value is provably above the final
+        # (m+2)-th smallest, which the hardware ApproxTopK's internal
+        # binning does not respect (a recall miss could select a
+        # skipped-vs-kept position differently).  Refuse rather than
+        # weaken the contract.
+        raise ValueError(
+            "kernel='fused' requires final_select='exact' (the "
+            "early-out's bitwise contract is an exact-boundary argument)")
     eff_tile = effective_tile(t.shape[0], tile_n, bin_w, survivors,
                               binning, m + 2)
     cd, ci, bounds = _bin_candidates(
@@ -997,9 +1177,33 @@ def local_certified_candidates(
         bin_w=bin_w, survivors=survivors, precision=precision,
         interpret=interpret, binning=binning, grid_order=grid_order,
         kernel=kernel, db_int8=db_int8, offset=offset,
+        keep=m + 2 if kernel == "fused" else None,
     )
     n_q = q.shape[0]
-    cd, ci, bounds = cd[:n_q], ci[:n_q], bounds[:n_q]
+    return cd[:n_q], ci[:n_q], bounds[:n_q]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("m", "final_select", "final_recall_target"),
+)
+def local_select_rescore(
+    q: jax.Array,
+    t: jax.Array,
+    cd: jax.Array,
+    ci: jax.Array,
+    bounds: jax.Array,
+    m: int,
+    *,
+    final_select: str = "exact",
+    final_recall_target: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Stage 2 of :func:`local_certified_candidates`: final top-(m+2)
+    select over the packed candidates, exclusion-value restoration, the
+    direct-difference f32 rescore gather, and lexicographic ordering —
+    the rescore/certify tail the pipeline-overlap path runs as its own
+    device program while the NEXT batch's coarse pass streams the
+    database."""
+    n_q = q.shape[0]
     w = cd.shape[1]
     if m + 2 > w:
         raise ValueError(

@@ -114,6 +114,30 @@ def _analysis_window(k: int, m: int) -> int:
     return min(k + 17, m + 1)
 
 
+def _overlap_ratio(intervals) -> float:
+    """Fraction of the pipeline's wall time during which >= 2 batches
+    were simultaneously in flight (interval = coarse-dispatch start to
+    result-repair end) — the honest, host-measurable overlap number:
+    it reports dispatch-timeline concurrency (what the bounded-depth
+    pipeline creates), not device-internal overlap (which needs a
+    hardware trace; obs.profiler).  0.0 for < 2 batches."""
+    if len(intervals) < 2:
+        return 0.0
+    events = []
+    for s, e in intervals:
+        events.append((s, 1))
+        events.append((e, -1))
+    events.sort()
+    in_flight, overlapped, prev = 0, 0.0, None
+    for t, delta in events:
+        if prev is not None and in_flight >= 2:
+            overlapped += t - prev
+        in_flight += delta
+        prev = t
+    wall = max(e for _, e in intervals) - min(s for s, _ in intervals)
+    return overlapped / wall if wall > 0 else 0.0
+
+
 _MERGES = ("allgather", "ring")
 
 #: Certified-path coarse selectors.  "exact" ranks every row (float32
@@ -444,6 +468,10 @@ class ShardedKNN:
         #: (k, placed query rows) -> dispatch count: every distinct pair is
         #: one traced/compiled XLA program shape (compile_cache_stats)
         self._dispatch_shapes: dict = {}
+        #: last pipeline-overlap run's measurements (depth, batches,
+        #: overlap_ratio, wall_s) — surfaced by search_certified stats
+        #: and ServingEngine.stats(); None until an overlap run happens
+        self._last_pipeline: Optional[dict] = None
         #: lazily built serving engines, keyed by ladder spec
         #: (buckets, min_bucket, max_bucket) — search_bucketed; the lock
         #: keeps concurrent cold calls from double-building an engine
@@ -795,6 +823,8 @@ class ShardedKNN:
         kernel: Optional[str] = None,
         tune_cache: Optional[str] = None,
         return_sqrt: bool = False,
+        overlap: Optional[bool] = None,
+        overlap_depth: Optional[int] = None,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
         Returns (dists_f64, idx, stats).  L2 and cosine (the certificate
@@ -857,7 +887,36 @@ class ShardedKNN:
         ``margin`` to push the fallback rate below 1%).  The resolved
         knob set and its provenance land in
         ``stats["pallas_knobs"]`` / ``stats["tuning"]``.
+
+        ``overlap`` (pallas selector only) runs the certified program as
+        a TWO-STAGE device pipeline split at the packed-candidate
+        boundary: batch i's select/rescore/certify tail executes while
+        batch i+1's coarse pass streams the database, with at most
+        ``overlap_depth`` (default 2; KNN_TPU_PIPELINE_DEPTH) batches in
+        flight and the candidate carry buffers donated between stages.
+        Results are BITWISE-identical to the sequential path (pinned in
+        tests/test_fused_overlap.py); ``stats["pipeline"]`` reports the
+        measured dispatch-timeline overlap ratio, mirrored by the
+        ``knn_tpu_pipeline_overlap_ratio`` gauge and a
+        ``certified.pipeline`` span.  None resolves the
+        ``KNN_TPU_PIPELINE_OVERLAP`` env switch (off by default — it is
+        a scheduling choice, never a result change, so it is NOT an
+        autotuner knob).
         """
+        import os as _os
+
+        if overlap is None:
+            # strict opt-in vocabulary, like serving.admission's env
+            # knobs: anything else (off/no/typos) stays sequential
+            overlap = _os.environ.get(
+                "KNN_TPU_PIPELINE_OVERLAP", "").strip().lower() in (
+                    "1", "true", "on", "yes")
+        if overlap_depth is None:
+            try:
+                overlap_depth = int(_os.environ.get(
+                    "KNN_TPU_PIPELINE_DEPTH", "2"))
+            except ValueError:
+                overlap_depth = 2
         if self.metric == "cosine":
             # runs the l2 certificate on unit vectors (db rows were
             # normalized at placement): EXACT for the f32-row-normalized
@@ -932,7 +991,8 @@ class ShardedKNN:
             )
             bad, n_corrected = self._certify_pallas(
                 batches, bs, m, d, i, q_np, db_np, db_norm_max,
-                want_distances=return_distances, **knobs,
+                want_distances=return_distances, overlap=overlap,
+                overlap_depth=overlap_depth, **knobs,
             )
         else:
             bad = self._certify_counted(
@@ -970,6 +1030,8 @@ class ShardedKNN:
             stats["rank_corrected_queries"] = n_corrected
             stats["pallas_knobs"] = knobs
             stats["tuning"] = tune_info
+            if overlap and self._last_pipeline is not None:
+                stats["pipeline"] = dict(self._last_pipeline)
         # mirror the quality signals into the telemetry registry — the
         # per-call stats dict stays the API, the registry accumulates the
         # process-lifetime truth a scraper reads (docs/OBSERVABILITY.md)
@@ -1104,7 +1166,8 @@ class ShardedKNN:
                       binning: str = "grouped",
                       final_recall_target: Optional[float] = None,
                       grid_order: str = "query_major",
-                      kernel: str = "tiled"):
+                      kernel: str = "tiled",
+                      split: bool = False):
         """(program, m, analysis_window) for the one-pass certified
         path — the ONE home of the kernel-geometry margin cap and the
         packed-output window, shared by :meth:`_certify_pallas` and
@@ -1159,6 +1222,28 @@ class ShardedKNN:
         # tile the kernel runs is provably the tile this m-cap assumed
         # (ADVICE r4: the raw-tile plumbing let the two diverge on small
         # padded dbs where m is capped by n_train)
+        if split:
+            # the two-stage pipeline's program pair, split at the
+            # packed-candidate boundary; the tail donates the candidate
+            # carries on backends whose XLA honors donation
+            import jax as _jax
+
+            coarse = _pallas_coarse_program(
+                self.mesh, m, eff_tile, precision, bin_w=bin_w,
+                survivors=survivors, block_q=block_q,
+                final_select=final_select, binning=binning,
+                grid_order=grid_order, kernel=kernel,
+                quant_offset=quant_offset,
+            )
+            tail = _pallas_tail_program(
+                self.mesh, m, self.k, self.merge, precision,
+                n_train=self.n_train, final_select=final_select,
+                include_distances=include_distances,
+                final_recall_target=final_recall_target,
+                quant_offset=quant_offset,
+                donate=_jax.default_backend() != "cpu",
+            )
+            return (coarse, tail), m, _analysis_window(self.k, m)
         prog = _pallas_certified_program(
             self.mesh, m, self.k, self.merge, eff_tile, precision,
             n_train=self.n_train, bin_w=bin_w, survivors=survivors,
@@ -1175,7 +1260,7 @@ class ShardedKNN:
         tile_n, precision, want_distances=True, bin_w=None, survivors=None,
         block_q=None, final_select="exact", binning="grouped",
         final_recall_target=None, grid_order="query_major",
-        kernel="tiled",
+        kernel="tiled", overlap=False, overlap_depth=2,
     ):
         """One-pass certificate, host side.  The device already ranked the
         candidates, flagged uncertified rows, and marked near-tie pairs
@@ -1184,7 +1269,24 @@ class ShardedKNN:
         the top-k distance block when ``want_distances``) — nothing wider
         crosses the slow device->host link — then repairs tie runs in
         float64 (ops.refine.rank_correct_runs).  Returns (flagged query
-        indices, rank-corrected query count)."""
+        indices, rank-corrected query count).
+
+        ``overlap=True`` runs the TWO-STAGE pipeline instead of the
+        one-shot program: the certified program is split at the
+        packed-candidate boundary (coarse kernel | select/rescore/
+        certify tail — _pallas_setup(split=True)), with at most
+        ``overlap_depth`` batches in flight (the PR-1 dispatch-ahead
+        discipline: drain the oldest before admitting a new one) so
+        batch i's rescore/certify/fetch/host-repair overlaps batch
+        i+1's coarse db stream.  Results are bitwise-identical to the
+        sequential path — both run the same kernel, the same
+        select/rescore ops, and the SAME certify/pack tail
+        (_certify_pack_spmd) — pinned in tests/test_fused_overlap.py.
+        The measured dispatch-timeline overlap lands in
+        ``self._last_pipeline`` + the knn_tpu_pipeline_overlap_ratio
+        gauge + a certified.pipeline span."""
+        import time as _time
+
         from knn_tpu.ops.refine import rank_correct_runs
 
         k = self.k
@@ -1196,7 +1298,7 @@ class ShardedKNN:
                                         binning=binning,
                                         final_recall_target=final_recall_target,
                                         grid_order=grid_order,
-                                        kernel=kernel)
+                                        kernel=kernel, split=overlap)
 
         # stage 1: dispatch every batch (async on device).  The operand
         # tail is precision-shaped (int8: the quantized placement; f32:
@@ -1215,22 +1317,16 @@ class ShardedKNN:
             eps = score_error_bound(q_np, pl8["stats"],
                                     offset=pl8["offset"])
             obs.histogram(_mn.CERTIFIED_QUANT_BOUND).observe_many(eps)
-        outs = []
-        for lo, chunk, pad in batches:
-            qp, _ = self._place_queries(chunk)
-            outs.append((qp, _retry_transient(
-                lambda q=qp: prog(q, self._tp, *ops_tail),
-                "pallas dispatch")))
-
-        # stage 2: per batch — ONE fetch of the packed output (the relay
-        # charges a fixed latency per transfer), then repair tie runs
         bad_mask = np.zeros(q_np.shape[0], dtype=bool)
         n_corrected = 0
-        for (lo, chunk, pad), (qp, packed) in zip(batches, outs):
+
+        def repair(lo, pad, packed, redo):
+            """ONE fetch of the packed output (the relay charges a fixed
+            latency per transfer), then float64 tie-run repair — shared
+            verbatim by the sequential and pipelined paths."""
+            nonlocal n_corrected
             take = bs - pad
-            packed_np = _fetch_or_redispatch(
-                packed, lambda q=qp: prog(q, self._tp, *ops_tail),
-                "pallas fetch")
+            packed_np = _fetch_or_redispatch(packed, redo, "pallas fetch")
             gi_np, tight_np, bad_np, dk_np = unpack_certified(
                 packed_np[:take], k, w, want_distances
             )
@@ -1243,6 +1339,65 @@ class ShardedKNN:
                 d[lo : lo + take] = dc
             i[lo : lo + take] = ic
             bad_mask[lo : lo + take] = bad_np
+
+        if overlap:
+            coarse, tail = prog
+            depth = max(1, int(overlap_depth))
+            intervals = []
+            pending = []
+            t_wall0 = _time.perf_counter()
+
+            def finalize(rec):
+                lo, pad, redo, packed, t0 = rec
+                repair(lo, pad, packed, redo)
+                intervals.append((t0, _time.perf_counter()))
+
+            for lo, chunk, pad in batches:
+                # the bounded in-flight window: drain the oldest batch
+                # (its tail already executed while later coarse passes
+                # streamed) before admitting a new one — the same
+                # depth discipline ServingEngine.replay() runs
+                while len(pending) >= depth:
+                    finalize(pending.pop(0))
+                t0 = _time.perf_counter()
+                qp, _ = self._place_queries(chunk)
+
+                def launch(q=qp):
+                    # one dispatch unit: the tail consumes (donates) the
+                    # coarse stage's candidate carries, so any retry
+                    # must re-run the coarse pass too
+                    cand = coarse(q, self._tp, *ops_tail)
+                    return tail(q, self._tp, *cand, *ops_tail)
+
+                packed = _retry_transient(launch, "pallas pipeline dispatch")
+                pending.append((lo, pad, launch, packed, t0))
+            while pending:
+                finalize(pending.pop(0))
+            wall = _time.perf_counter() - t_wall0
+            ratio = _overlap_ratio(intervals)
+            self._last_pipeline = {
+                "depth": depth,
+                "batches": len(batches),
+                "overlap_ratio": round(ratio, 4),
+                "wall_s": round(wall, 4),
+            }
+            obs.gauge(_mn.PIPELINE_OVERLAP_RATIO).set(ratio)
+            obs.record_span("certified.pipeline", None, wall,
+                            batches=len(batches), depth=depth,
+                            overlap_ratio=round(ratio, 4))
+            return np.flatnonzero(bad_mask), n_corrected
+
+        outs = []
+        for lo, chunk, pad in batches:
+            qp, _ = self._place_queries(chunk)
+            outs.append((qp, _retry_transient(
+                lambda q=qp: prog(q, self._tp, *ops_tail),
+                "pallas dispatch")))
+
+        # stage 2: per batch — fetch + repair, in dispatch order
+        for (lo, chunk, pad), (qp, packed) in zip(batches, outs):
+            repair(lo, pad, packed,
+                   lambda q=qp: prog(q, self._tp, *ops_tail))
         return np.flatnonzero(bad_mask), n_corrected
 
     def predict_certified(
@@ -1431,7 +1586,6 @@ def _pallas_certified_program(
     from knn_tpu.ops.pallas_knn import (
         BIN_W,
         BLOCK_Q,
-        RANK_SLACK,
         TILE_N,
         local_certified_candidates,
     )
@@ -1444,12 +1598,7 @@ def _pallas_certified_program(
     int8 = precision == "int8"
 
     def spmd(q, t, *tail):
-        if int8:
-            tq, ts, tnr, consts = tail
-            db_int8 = (tq, ts, tnr)
-        else:
-            (db_norm_max,) = tail
-            db_int8 = None
+        db_int8, consts, db_norm_max = _split_operand_tail(int8, tail)
         d32, li, lb = local_certified_candidates(
             q, t, m, tile_n=eff_tile, bin_w=eff_bin, survivors=survivors,
             block_q=eff_bq, final_select=final_select, precision=precision,
@@ -1457,88 +1606,219 @@ def _pallas_certified_program(
             grid_order=grid_order, kernel=kernel,
             db_int8=db_int8, offset=quant_offset,
         )
-        db_idx = lax.axis_index(DB_AXIS)
-        gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,
-                       li + db_idx * t.shape[0])
-        if n_train is not None:
-            # pre-placed databases may be zero-padded by the caller (the
-            # multihost contract); rows past n_train are padding, and a
-            # zero pad row sits at the origin — mask by GLOBAL index so
-            # it can never be returned as a neighbor
-            pad = gi >= n_train
-            gi = jnp.where(pad, _INT_SENTINEL, gi)
-            d32 = jnp.where(pad, jnp.inf, d32)
-        if db_shards > 1:
-            if merge == "ring":
-                d32, gi = _ring_merge(d32, gi, m + 1, DB_AXIS, db_shards)
-            else:
-                d32, gi = _allgather_merge(d32, gi, m + 1, DB_AXIS)
-            lb = lax.pmin(lb, axis_name=DB_AXIS)
+        return _certify_pack_spmd(
+            q, t, d32, li, lb, consts=consts, db_norm_max=db_norm_max,
+            precision=precision, quant_offset=quant_offset, m=m, k=k, w=w,
+            merge=merge, n_train=n_train, db_shards=db_shards,
+            include_distances=include_distances,
+        )
 
-        # --- device rank analysis over the window [0, w) ---------------
-        dw = d32[:, :w]
-        gaps = dw[:, 1:] - dw[:, :-1]  # [Q, w-1]
-        # isfinite guard: an (x, inf-sentinel) pair yields inf <= inf,
-        # which must not count as a near-tie
-        tight = (gaps <= RANK_SLACK * dw[:, 1:]) & jnp.isfinite(dw[:, 1:])
-        pair = lax.broadcasted_iota(jnp.int32, tight.shape, 1)
-        big_after = (~tight) & (pair >= k - 1)
-        has_stop = big_after.any(axis=-1)
-        stop = jnp.where(has_stop, jnp.argmax(big_after, axis=-1), w - 1)
-        # rows without a provable boundary (or junk near it) rerun exactly
-        unresolved = (~has_stop) | ~jnp.isfinite(dw[:, : k + 1]).all(-1)
-        tight_use = tight & (pair < stop[:, None]) & ~unresolved[:, None]
-
-        # --- device certificate ----------------------------------------
-        # tolerances mirror ops.pallas_knn.kernel_tolerance and include
-        # the extra f32 reduction this on-device path adds (q_norm +
-        # s_k arithmetic, <= ~12 eps of the norm scale): "highest" budgets
-        # 32 eps total; bf16x3's 2^-14 dwarfs the f32 terms either way.
-        # int8's tolerance is the per-query PROVABLE quantization bound ε
-        # from the ACTUAL residual norms — byte-exact data (bvecs) gets
-        # an ε of pure f32 slack, tighter than bf16x3's.
-        q32 = q.astype(jnp.float32)
-        if int8:
-            from knn_tpu.ops.quantize import score_error_bound_device
-
-            q_norm, tol = score_error_bound_device(
-                q32 - quant_offset, consts)
-        elif precision in ("bf16x3", "bf16x3f"):
-            q_norm = jnp.sum(q32 * q32, axis=-1)
-            tol = 2.0 ** -14 * (q_norm + db_norm_max)
-        else:
-            q_norm = jnp.sum(q32 * q32, axis=-1)
-            tol = 32.0 * float(np.finfo(np.float32).eps) * (
-                q_norm + db_norm_max)
-        d_k = dw[:, k - 1]
-        s_k = d_k - q_norm
-        bad = s_k + RANK_SLACK * d_k + tol >= lb
-        if db_shards > 1:
-            # merge-dropped candidates have direct-diff f32 distance
-            # >= the (m+1)-th kept; require true-distance clearance
-            bad = bad | (d_k + RANK_SLACK * d_k
-                         >= d32[:, m] * (1.0 - RANK_SLACK))
-        bad = bad | unresolved
-        cols = [
-            gi[:, :w],
-            lax.bitcast_convert_type(_pack_bits_u32(tight_use), jnp.int32),
-            bad.astype(jnp.int32)[:, None],
-        ]
-        if include_distances:
-            cols.append(lax.bitcast_convert_type(d32[:, :k], jnp.int32))
-        return jnp.concatenate(cols, axis=1)
-
-    tail_specs = (
-        (P(DB_AXIS), P(DB_AXIS), P(DB_AXIS), P()) if int8 else (P(),)
-    )
     return jax.jit(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS), *tail_specs),
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS), *_tail_specs(int8)),
             out_specs=P(QUERY_AXIS),
             check_vma=False,
         )
+    )
+
+
+def _tail_specs(int8: bool):
+    """shard_map in_specs of the precision-shaped operand tail
+    (ShardedKNN._pallas_operands): int8 = the quantized placement
+    (db-sharded values/scales/norms + replicated bound consts), f32 =
+    the replicated scalar db-norm bound."""
+    return (P(DB_AXIS), P(DB_AXIS), P(DB_AXIS), P()) if int8 else (P(),)
+
+
+def _split_operand_tail(int8: bool, tail):
+    """(db_int8, consts, db_norm_max) from the operand tail — the
+    per-precision unpacking every pallas-certified program shares."""
+    if int8:
+        tq, ts, tnr, consts = tail
+        return (tq, ts, tnr), consts, None
+    (db_norm_max,) = tail
+    return None, None, db_norm_max
+
+
+def _certify_pack_spmd(q, t, d32, li, lb, *, consts, db_norm_max,
+                       precision, quant_offset, m, k, w, merge, n_train,
+                       db_shards, include_distances):
+    """The certify/pack tail of the pallas certified program, from one
+    shard's ranked candidates ``(d32, li, lb)`` to the packed host-facing
+    int32 array — ONE home shared by the one-shot program
+    (:func:`_pallas_certified_program`) and the pipeline-overlap tail
+    stage (:func:`_pallas_tail_program`), which is what makes the
+    two-stage path bitwise-identical to the sequential one: same merge,
+    same rank analysis, same certificate, same packing, running inside
+    either program."""
+    from knn_tpu.ops.pallas_knn import RANK_SLACK
+
+    int8 = precision == "int8"
+    db_idx = lax.axis_index(DB_AXIS)
+    gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,
+                   li + db_idx * t.shape[0])
+    if n_train is not None:
+        # pre-placed databases may be zero-padded by the caller (the
+        # multihost contract); rows past n_train are padding, and a
+        # zero pad row sits at the origin — mask by GLOBAL index so
+        # it can never be returned as a neighbor
+        pad = gi >= n_train
+        gi = jnp.where(pad, _INT_SENTINEL, gi)
+        d32 = jnp.where(pad, jnp.inf, d32)
+    if db_shards > 1:
+        if merge == "ring":
+            d32, gi = _ring_merge(d32, gi, m + 1, DB_AXIS, db_shards)
+        else:
+            d32, gi = _allgather_merge(d32, gi, m + 1, DB_AXIS)
+        lb = lax.pmin(lb, axis_name=DB_AXIS)
+
+    # --- device rank analysis over the window [0, w) ---------------
+    dw = d32[:, :w]
+    gaps = dw[:, 1:] - dw[:, :-1]  # [Q, w-1]
+    # isfinite guard: an (x, inf-sentinel) pair yields inf <= inf,
+    # which must not count as a near-tie
+    tight = (gaps <= RANK_SLACK * dw[:, 1:]) & jnp.isfinite(dw[:, 1:])
+    pair = lax.broadcasted_iota(jnp.int32, tight.shape, 1)
+    big_after = (~tight) & (pair >= k - 1)
+    has_stop = big_after.any(axis=-1)
+    stop = jnp.where(has_stop, jnp.argmax(big_after, axis=-1), w - 1)
+    # rows without a provable boundary (or junk near it) rerun exactly
+    unresolved = (~has_stop) | ~jnp.isfinite(dw[:, : k + 1]).all(-1)
+    tight_use = tight & (pair < stop[:, None]) & ~unresolved[:, None]
+
+    # --- device certificate ----------------------------------------
+    # tolerances mirror ops.pallas_knn.kernel_tolerance and include
+    # the extra f32 reduction this on-device path adds (q_norm +
+    # s_k arithmetic, <= ~12 eps of the norm scale): "highest" budgets
+    # 32 eps total; bf16x3's 2^-14 dwarfs the f32 terms either way.
+    # int8's tolerance is the per-query PROVABLE quantization bound ε
+    # from the ACTUAL residual norms — byte-exact data (bvecs) gets
+    # an ε of pure f32 slack, tighter than bf16x3's.
+    q32 = q.astype(jnp.float32)
+    if int8:
+        from knn_tpu.ops.quantize import score_error_bound_device
+
+        q_norm, tol = score_error_bound_device(
+            q32 - quant_offset, consts)
+    elif precision in ("bf16x3", "bf16x3f"):
+        q_norm = jnp.sum(q32 * q32, axis=-1)
+        tol = 2.0 ** -14 * (q_norm + db_norm_max)
+    else:
+        q_norm = jnp.sum(q32 * q32, axis=-1)
+        tol = 32.0 * float(np.finfo(np.float32).eps) * (
+            q_norm + db_norm_max)
+    d_k = dw[:, k - 1]
+    s_k = d_k - q_norm
+    bad = s_k + RANK_SLACK * d_k + tol >= lb
+    if db_shards > 1:
+        # merge-dropped candidates have direct-diff f32 distance
+        # >= the (m+1)-th kept; require true-distance clearance
+        bad = bad | (d_k + RANK_SLACK * d_k
+                     >= d32[:, m] * (1.0 - RANK_SLACK))
+    bad = bad | unresolved
+    cols = [
+        gi[:, :w],
+        lax.bitcast_convert_type(_pack_bits_u32(tight_use), jnp.int32),
+        bad.astype(jnp.int32)[:, None],
+    ]
+    if include_distances:
+        cols.append(lax.bitcast_convert_type(d32[:, :k], jnp.int32))
+    return jnp.concatenate(cols, axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_coarse_program(
+    mesh: Mesh, m: int, tile_n: Optional[int], precision: str,
+    bin_w: Optional[int] = None, survivors: Optional[int] = None,
+    block_q: Optional[int] = None, final_select: str = "exact",
+    binning: str = "grouped", grid_order: str = "query_major",
+    kernel: str = "tiled", quant_offset: float = 0.0,
+):
+    """Stage 1 of the two-stage certified pipeline: the db-streaming
+    coarse pass alone (ops.pallas_knn.local_coarse_candidates per
+    shard), returning the packed per-shard candidate blocks
+    ``(cd, ci, bounds)`` concatenated along the db axis — the
+    packed-candidate boundary the pipeline overlap splits the certified
+    program on.  Takes the SAME operand tail as the one-shot program
+    (unused pieces ignored) so callers keep ONE operand home."""
+    from knn_tpu.ops.pallas_knn import (
+        BIN_W,
+        BLOCK_Q,
+        TILE_N,
+        local_coarse_candidates,
+    )
+
+    int8 = precision == "int8"
+
+    def spmd(q, t, *tail):
+        db_int8, _, _ = _split_operand_tail(int8, tail)
+        return local_coarse_candidates(
+            q, t, m, tile_n=tile_n or TILE_N, bin_w=bin_w or BIN_W,
+            survivors=survivors, block_q=block_q or BLOCK_Q,
+            precision=precision, binning=binning,
+            grid_order=grid_order, kernel=kernel, db_int8=db_int8,
+            offset=quant_offset, final_select=final_select,
+        )
+
+    return jax.jit(
+        shard_map_compat(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS), *_tail_specs(int8)),
+            out_specs=(P(QUERY_AXIS, DB_AXIS), P(QUERY_AXIS, DB_AXIS),
+                       P(QUERY_AXIS, DB_AXIS)),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _pallas_tail_program(
+    mesh: Mesh, m: int, k: int, merge: str, precision: str,
+    n_train: Optional[int] = None, final_select: str = "exact",
+    include_distances: bool = True,
+    final_recall_target: Optional[float] = None,
+    quant_offset: float = 0.0, donate: bool = False,
+):
+    """Stage 2 of the two-stage certified pipeline: final select +
+    rescore gather (ops.pallas_knn.local_select_rescore) + the shared
+    certify/pack tail (:func:`_certify_pack_spmd`).  ``donate=True``
+    donates the candidate carry buffers (cd/ci/bounds — the largest
+    arrays in flight) to the program so each batch's carries recycle
+    instead of accumulating across the pipeline window; CPU XLA rejects
+    donation, so callers pass False there."""
+    from knn_tpu.ops.pallas_knn import local_select_rescore
+
+    db_shards = mesh.shape[DB_AXIS]
+    w = _analysis_window(k, m)
+    int8 = precision == "int8"
+
+    def spmd(q, t, cd, ci, bounds, *tail):
+        _, consts, db_norm_max = _split_operand_tail(int8, tail)
+        d32, li, lb = local_select_rescore(
+            q, t, cd, ci, bounds, m, final_select=final_select,
+            final_recall_target=final_recall_target,
+        )
+        return _certify_pack_spmd(
+            q, t, d32, li, lb, consts=consts, db_norm_max=db_norm_max,
+            precision=precision, quant_offset=quant_offset, m=m, k=k, w=w,
+            merge=merge, n_train=n_train, db_shards=db_shards,
+            include_distances=include_distances,
+        )
+
+    return jax.jit(
+        shard_map_compat(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P(QUERY_AXIS, DB_AXIS),
+                      P(QUERY_AXIS, DB_AXIS), P(QUERY_AXIS, DB_AXIS),
+                      *_tail_specs(int8)),
+            out_specs=P(QUERY_AXIS),
+            check_vma=False,
+        ),
+        donate_argnums=(2, 3, 4) if donate else (),
     )
 
 

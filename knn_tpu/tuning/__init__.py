@@ -18,9 +18,12 @@ they may win — a fast wrong kernel can never be selected.
 
 from knn_tpu.tuning.autotune import (
     DEFAULT_KNOBS,
+    PRUNE_ENV,
     autotune,
     counters,
     knob_grid,
+    prune_candidates,
+    prune_threshold_from_env,
     reset_counters,
     resolve,
     resolve_full,
@@ -34,9 +37,12 @@ from knn_tpu.tuning.cache import (
 
 __all__ = [
     "DEFAULT_KNOBS",
+    "PRUNE_ENV",
     "autotune",
     "counters",
     "knob_grid",
+    "prune_candidates",
+    "prune_threshold_from_env",
     "reset_counters",
     "resolve",
     "resolve_full",

@@ -29,6 +29,7 @@ The public entry points:
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -43,11 +44,17 @@ from knn_tpu.tuning.cache import TuneCache, cache_key, default_cache_path
 #: keyword arguments of ShardedKNN.search_certified's pallas selector.
 #: Values are the library defaults (None = the ops.pallas_knn
 #: module-constant default at the use site), so a cache miss with no
-#: overrides reproduces today's behavior bit for bit.
+#: overrides reproduces the reference behavior bit for bit.
+#: ``block_q=256`` is the r05-proven promotion (docs/PERF.md round-5
+#: evidence: bq256 measured 1.2-1.4x the bq128 kernel at the SIFT
+#: shape on v5e) — block_q only re-blocks the query grid, the per-row
+#: arithmetic is untouched, so results are bitwise-identical to the
+#: old default; KERNEL_VERSION=4 re-keys the persisted winner cache so
+#: entries measured against bq128 reference runs self-invalidate.
 DEFAULT_KNOBS: Dict[str, object] = {
     "kernel": "tiled",
     "tile_n": None,
-    "block_q": None,
+    "block_q": 256,
     "bin_w": None,
     "survivors": None,
     "precision": "bf16x3",
@@ -57,6 +64,13 @@ DEFAULT_KNOBS: Dict[str, object] = {
     "final_recall_target": None,
 }
 
+#: env switch for roofline-model candidate pruning in :func:`autotune`
+#: — a fraction in (0, 1]: candidates whose MODELED ceiling sits below
+#: ``threshold x best modeled ceiling`` are skipped before timing
+#: (recorded in the entry's ``pruning`` provenance, never silently).
+#: Unset/0 = off (every candidate times, the pre-pruning behavior).
+PRUNE_ENV = "KNN_TPU_TUNE_PRUNE"
+
 _counters_lock = threading.Lock()
 _COUNTERS = {
     "resolve_calls": 0,      # resolve() invocations
@@ -65,6 +79,7 @@ _COUNTERS = {
     "tune_searches": 0,      # autotune() runs that actually searched
     "candidates_timed": 0,   # candidates built+timed (0 on a warm cache)
     "candidates_gated_out": 0,  # candidates rejected by the bitwise gate
+    "candidates_pruned": 0,  # skipped before timing by the roofline model
 }
 
 
@@ -92,6 +107,7 @@ _OBS_TWIN = {
     "tune_searches": _mn.TUNING_SEARCHES,
     "candidates_timed": _mn.TUNING_CANDIDATES_TIMED,
     "candidates_gated_out": _mn.TUNING_GATE_FAILURES,
+    "candidates_pruned": _mn.TUNING_CANDIDATES_PRUNED,
 }
 
 
@@ -223,15 +239,19 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
     def add(**deviations):
         knobs = dict(DEFAULT_KNOBS)
         knobs.update(deviations)
-        if (knobs["kernel"] == "streaming"
+        if (knobs["kernel"] in ("streaming", "fused")
                 and knobs["grid_order"] != "query_major"):
-            return
+            return  # no db grid axis to reorder (ops.pallas_knn refuses)
+        if knobs["kernel"] == "fused" and (
+                knobs["final_select"] == "approx"
+                or knobs["binning"] != "grouped"):
+            return  # the early-out's bitwise contract is exact+grouped
         lbl = _label(knobs)
         if lbl not in seen:
             seen.add(lbl)
             out.append(knobs)
 
-    for kern in ("tiled", "streaming"):
+    for kern in ("tiled", "streaming", "fused"):
         for order in ("query_major", "db_major"):
             add(kernel=kern, grid_order=order)
     add(final_select="approx")
@@ -239,23 +259,107 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
         return out
     for tile in (8192, 32768):
         add(tile_n=tile)
-    add(block_q=256)
-    add(tile_n=32768, block_q=256)  # the r5-projected winner cross
-    add(tile_n=32768, block_q=256, final_select="approx")
+    add(block_q=128)  # the pre-r05 default, kept as the A/B deviation
+    add(tile_n=32768)  # the r5-projected winner cross (bq256 is default)
+    add(tile_n=32768, final_select="approx")
     for prec in ("bf16x3f", "highest", "int8"):
         add(precision=prec)
     add(precision="int8", kernel="streaming")  # the HBM-bound cross
+    # the vpu_select_bound attack the fused arm exists for, plus its
+    # larger-tile r05-proven cross
+    add(precision="int8", kernel="fused")
+    add(kernel="fused", tile_n=32768)
     if level == "standard":
         return out
+    # block_q enumerates EXPLICIT values: None would fall back to the
+    # kernel-module default (128) and silently duplicate the 128 point
+    # now that the tuning default is 256
     for tile, bq, order, prec, kern in itertools.product(
-            (None, 8192, 32768), (None, 256),
+            (None, 8192, 32768), (256, 128),
             ("query_major", "db_major"), ("bf16x3", "bf16x3f", "int8"),
-            ("tiled", "streaming")):
+            ("tiled", "streaming", "fused")):
         add(tile_n=tile, block_q=bq, grid_order=order, precision=prec,
             kernel=kern)
         add(tile_n=tile, block_q=bq, grid_order=order, precision=prec,
             kernel=kern, final_select="approx")
     return out
+
+
+def prune_threshold_from_env() -> Optional[float]:
+    """The ``KNN_TPU_TUNE_PRUNE`` fraction, or None when pruning is off
+    (unset, empty, 0, or unparseable — a typo'd switch must degrade to
+    the exhaustive search, never silently prune).  Values above 1 clamp
+    to 1.0: the best-modeled candidate is always kept either way."""
+    raw = os.environ.get(PRUNE_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        val = float(raw)
+    except ValueError:
+        return None
+    if val <= 0:
+        return None
+    return min(val, 1.0)
+
+
+def prune_candidates(
+    candidates: Sequence[Dict[str, object]], *, n: int, d: int, k: int,
+    nq: int, threshold: float, device_kind: Optional[str] = None,
+    backend: Optional[str] = None, margin: int = 28,
+) -> Tuple[List[Dict[str, object]], Dict[str, dict], Optional[float]]:
+    """Roofline-model candidate pruning for :func:`autotune`:
+    ``(kept, pruned, best_ceiling_qps)``.  Each candidate's analytic
+    ceiling (knn_tpu.obs.roofline) is computed BEFORE any timing;
+    candidates whose ceiling sits below ``threshold x best`` are
+    dropped from the timing loop, with their modeled ceiling recorded
+    in ``pruned`` so the decision is auditable line by line.
+
+    Guarantees, pinned in tests/test_fused_overlap.py:
+
+    - the best-modeled candidate is ALWAYS kept (its ceiling equals
+      ``best``, and ``threshold <= 1``);
+    - a candidate the model CANNOT price (an error, a missing ceiling)
+      is always kept — a model gap must widen the search, never hide a
+      candidate;
+    - every pruned record carries ``ceiling_qps < threshold * best``,
+      so the property "pruning never hid a winner" is checkable after
+      the fact against the pruning-off timings."""
+    from knn_tpu.obs import roofline
+
+    models: List[Tuple[Dict[str, object], Optional[dict]]] = []
+    for cand in candidates:
+        knobs = dict(DEFAULT_KNOBS)
+        knobs.update(cand)
+        try:
+            model = roofline.pallas_cost_model(
+                n=n, d=d, k=k, nq=nq, precision=knobs["precision"],
+                kernel=knobs["kernel"], grid_order=knobs["grid_order"],
+                binning=knobs["binning"], tile_n=knobs["tile_n"],
+                block_q=knobs["block_q"], survivors=knobs["survivors"],
+                margin=margin, device_kind=device_kind, backend=backend)
+            if not model.get("ceiling_qps"):
+                model = None
+        except Exception:  # noqa: BLE001 — a model gap never prunes
+            model = None
+        models.append((cand, model))
+    ceilings = [m["ceiling_qps"] for _, m in models if m is not None]
+    best = max(ceilings) if ceilings else None
+    kept: List[Dict[str, object]] = []
+    pruned: Dict[str, dict] = {}
+    for cand, model in models:
+        if best is None or model is None or \
+                model["ceiling_qps"] >= threshold * best:
+            kept.append(cand)
+            continue
+        knobs = dict(DEFAULT_KNOBS)
+        knobs.update(cand)
+        pruned[_label(knobs)] = {
+            "ceiling_qps": model["ceiling_qps"],
+            "bound_class": model.get("bound_class"),
+            "best_ceiling_qps": best,
+            "threshold": threshold,
+        }
+    return kept, pruned, best
 
 
 def _quantized_db(db):
@@ -354,6 +458,7 @@ def autotune(
     grid_level: str = "standard", runs: int = 2,
     cache_path: Optional[str] = None, device_kind: Optional[str] = None,
     dtype: Optional[str] = None, force: bool = False,
+    prune: Optional[float] = None,
 ) -> Dict[str, object]:
     """Search the knob grid for ``(db, queries, k, metric)`` and persist
     the winner; returns the cache entry (plus ``"cached": True`` when a
@@ -385,6 +490,17 @@ def autotune(
     ``KNN_TPU_PROFILE_DIR`` set, one extra fenced run of the winner is
     captured as an XLA device trace (``entry["trace_dir"]``), outside
     every timing.
+
+    **Roofline pruning** (``prune`` arg > ``KNN_TPU_TUNE_PRUNE`` env;
+    off by default): before ANY timing, every candidate's analytic
+    ceiling is modeled (:func:`prune_candidates`) and candidates below
+    ``threshold x best modeled ceiling`` are skipped — on hardware the
+    grid's timing cost drops to the model-plausible region.  Every skip
+    is recorded in ``entry["pruning"]["pruned"]`` with its modeled
+    ceiling (and mirrored as a ``roofline-pruned: ...`` entry in
+    ``errors``) so the decision is auditable: a pruned candidate that
+    would have won the bitwise+timing gate with pruning off is a test
+    failure, not a silent loss (tests/test_fused_overlap.py).
     """
     import jax
 
@@ -427,6 +543,35 @@ def autotune(
     errors: Dict[str, str] = {}
     rooflines: Dict[str, dict] = {}
     backend = jax.default_backend()
+
+    # roofline-model pruning BEFORE any timing (opt-in; see docstring):
+    # pre-seeding timings/errors keeps pruned candidates out of the
+    # timing loop via its duplicate check while leaving a full audit
+    # trail in the entry
+    threshold = prune if prune is not None else prune_threshold_from_env()
+    pruning_info = None
+    if threshold:
+        threshold = min(float(threshold), 1.0)
+        kept, pruned_rec, best_ceiling = prune_candidates(
+            candidates, n=n, d=d, k=k, nq=queries.shape[0],
+            threshold=threshold, device_kind=device_kind,
+            backend=backend, margin=margin)
+        for label, rec in pruned_rec.items():
+            timings[label] = None
+            errors[label] = (
+                f"roofline-pruned: modeled ceiling "
+                f"{rec['ceiling_qps']} < {threshold} x best "
+                f"{rec['best_ceiling_qps']}")
+        if pruned_rec:
+            _bump("candidates_pruned", len(pruned_rec))
+        pruning_info = {
+            "threshold": threshold,
+            "best_ceiling_qps": best_ceiling,
+            "candidates_modeled": len(candidates),
+            "candidates_pruned": len(pruned_rec),
+            "pruned": pruned_rec,
+        }
+        candidates = kept
     best_label, best_ms, best_knobs = None, None, None
     for cand in candidates:
         knobs = dict(DEFAULT_KNOBS)
@@ -514,6 +659,8 @@ def autotune(
         "jax_version": jax.__version__,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if pruning_info is not None:
+        entry["pruning"] = pruning_info
     if winner_rl is not None:
         entry["roofline"] = winner_rl
         entry["roofline_pct"] = winner_rl["roofline_pct"]

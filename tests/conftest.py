@@ -27,7 +27,13 @@ os.environ["KNN_TPU_TUNE_CACHE"] = os.path.join(
 # their own paths/values explicitly)
 for _knob in ("KNN_TPU_OBS", "KNN_TPU_OBS_LOG",
               "KNN_TPU_OBS_LOG_MAX_BYTES", "KNN_TPU_SLO_CONFIG",
-              "KNN_TPU_POSTMORTEM_DIR", "KNN_TPU_POSTMORTEM_KEEP"):
+              "KNN_TPU_POSTMORTEM_DIR", "KNN_TPU_POSTMORTEM_KEEP",
+              # an ambient prune threshold would silently shrink every
+              # autotune grid in the suite; an ambient overlap switch
+              # would flip every certified search onto the pipelined
+              # path (tests that exercise them set their own values)
+              "KNN_TPU_TUNE_PRUNE", "KNN_TPU_PIPELINE_OVERLAP",
+              "KNN_TPU_PIPELINE_DEPTH"):
     os.environ.pop(_knob, None)
 # isolate the admission-control and loadgen knobs: a developer shell's
 # ambient KNN_TPU_ADMISSION_* would silently flip every QueryQueue in
