@@ -1,0 +1,46 @@
+"""Machine-readable source annotations the checkers key on.
+
+Stdlib-only and import-cycle-free by construction (this module imports
+nothing from knn_tpu): runtime modules — serving, obs, tuning — mark
+their own hot paths and thread-safety contracts here, and the AST
+checkers (knn_tpu.analysis) read the markers WITHOUT importing those
+modules.
+
+Two conventions:
+
+- ``@hot_path`` / ``@hot_path(allow=("np.asarray",))`` — a function on
+  the serving/dispatch hot path.  The jax-hygiene checker flags
+  host-sync calls (``.block_until_ready()``, ``jax.device_get``,
+  ``.item()``, ``.tolist()``, ``np.asarray``/``np.array``,
+  ``float()``/``int()`` of a call result) and wall-clock reads
+  (``time.time()``) inside it.  ``allow`` whitelists specific call
+  names AT the annotation — the exemption rides next to the code it
+  exempts, with the decorator itself as the written record (e.g. input
+  coercion of host-side request arrays is np.asarray-by-design).
+  Runtime cost: one identity call at def time, zero per invocation.
+
+- **Thread-safety docstring markers** (no runtime artifact at all):
+  a class whose docstring contains ``Thread-safety: guarded by
+  ``self._lock``.`` (any attribute name) opts into the concurrency
+  checker — writes to shared attributes outside a ``with self._lock:``
+  block become findings.  A helper method that REQUIRES the lock held
+  declares it with ``Caller holds ``self._lock``.`` in its docstring.
+  Grammar: knn_tpu/analysis/check_concurrency.py and docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+
+def hot_path(fn: Callable = None, *, allow: Sequence[str] = ()):
+    """Mark a function as serving-hot-path (see module docstring).
+    Identity at runtime; the checker reads the decorator — and its
+    ``allow`` tuple — from the AST."""
+    if fn is not None:  # bare @hot_path
+        return fn
+
+    def wrap(f):
+        return f
+
+    return wrap

@@ -1,0 +1,254 @@
+"""``jax-hygiene`` — host syncs, wall clocks, and unhashable static
+args, caught at lint time.
+
+Three rule families over ``knn_tpu/`` (library code only — scripts/
+are session drivers where wall-clock reads are the point):
+
+1. **Wall clock**: ``time.time()`` anywhere is a finding.  Durations
+   in this repo come from ``time.perf_counter``/``time.monotonic``
+   (wall time is not monotonic: NTP steps corrupt a latency
+   measurement exactly once, unreproducibly).  The few legitimate
+   uses — display timestamps that are never differenced — carry
+   suppression entries with that justification, so every NEW wall
+   clock read has to argue its case.
+
+2. **Hot-path host syncs**: inside a function marked
+   ``@hot_path`` (knn_tpu.analysis.annotations), calls that force a
+   host round-trip or materialize device data —
+   ``.block_until_ready()``, ``jax.device_get``, ``.item()``,
+   ``.tolist()``, ``np.asarray``/``np.array``/``np.ascontiguousarray``,
+   ``float(...)``/``int(...)`` of a non-trivial expression — are
+   findings.  The async dispatch pipeline is the serving layer's whole
+   throughput story; one stray sync serializes it silently.  The
+   decorator's ``allow=("np.asarray", ...)`` tuple whitelists specific
+   calls AT the annotation (e.g. host-side input coercion), keeping
+   the exemption next to the code it exempts.
+
+3. **Unhashable static args** (same-file analysis): a call site that
+   passes a list/dict/set display (or comprehension) to a parameter
+   the callee declares in ``jax.jit(..., static_argnames=...)`` raises
+   ``TypeError`` at runtime — or, with a tuple rebuilt per call from
+   varying contents, recompiles silently.  Also flagged: a jitted
+   function whose static parameter has a mutable default.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from knn_tpu.analysis.core import Context, Finding, checker
+
+#: call names forbidden inside @hot_path functions (dotted-tail match).
+#: ``time.time`` is deliberately absent: the wall-clock rule already
+#: flags every read ONCE, everywhere — listing it here would double-
+#: report the same call inside hot paths, and a hot-path ``allow``
+#: tuple must never be able to whitelist a wall clock (that exemption
+#: requires a justified suppression entry)
+HOT_FORBIDDEN = (
+    ".block_until_ready",
+    "jax.device_get",
+    ".item",
+    ".tolist",
+    "np.asarray",
+    "np.array",
+    "np.ascontiguousarray",
+    "jnp.asarray",
+)
+
+_MUTABLE_DISPLAYS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp, ast.GeneratorExp)
+
+
+def _call_name(func: ast.AST) -> str:
+    """Render a call target as a dotted name: ``time.time``,
+    ``.block_until_ready`` (unknown receiver), ``float``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name):
+            return f"{base.id}.{func.attr}"
+        return f".{func.attr}"
+    return ""
+
+
+def _matches(name: str, pattern: str) -> bool:
+    if pattern.startswith("."):
+        return name.endswith(pattern) or name == pattern.lstrip(".")
+    return name == pattern or name.endswith("." + pattern)
+
+
+def _hot_path_allow(dec: ast.AST) -> Optional[Tuple[str, ...]]:
+    """The ``allow`` tuple when ``dec`` is a hot_path decorator (bare
+    or called), else None."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = _call_name(target)
+    if not (name == "hot_path" or name.endswith(".hot_path")):
+        return None
+    allow: List[str] = []
+    if isinstance(dec, ast.Call):
+        for kw in dec.keywords:
+            if kw.arg == "allow" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                allow.extend(
+                    e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str))
+    return tuple(allow)
+
+
+def _jit_static_names(call: ast.Call) -> Optional[Set[str]]:
+    """The static_argnames set when ``call`` is a ``jax.jit``
+    (or ``functools.partial(jax.jit, ...)``) invocation, else None."""
+    name = _call_name(call.func)
+    inner = call
+    if name.endswith("partial") and call.args and \
+            isinstance(call.args[0], (ast.Name, ast.Attribute)) and \
+            _matches(_call_name(call.args[0]), "jax.jit"):
+        inner = call
+    elif not _matches(name, "jax.jit"):
+        return None
+    out: Set[str] = set()
+    for kw in inner.keywords:
+        if kw.arg == "static_argnames" and isinstance(
+                kw.value, (ast.Tuple, ast.List)):
+            out.update(e.value for e in kw.value.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str))
+        elif kw.arg == "static_argnames" and isinstance(
+                kw.value, ast.Constant) and isinstance(
+                kw.value.value, str):
+            out.add(kw.value.value)
+    return out
+
+
+def _scan_hot_path(relpath: str, fn: ast.FunctionDef,
+                   allow: Sequence[str],
+                   findings: List[Finding]) -> None:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if not name:
+            continue
+        hit = None
+        for pat in HOT_FORBIDDEN:
+            if _matches(name, pat):
+                hit = pat
+                break
+        if hit is None and name in ("float", "int") and node.args and \
+                isinstance(node.args[0], (ast.Call, ast.Subscript)):
+            hit = name  # float(x.something()) — likely a device fetch
+        if hit is None:
+            continue
+        if any(_matches(name, a) or a == hit for a in allow):
+            continue
+        findings.append(Finding(
+            checker="jax-hygiene", path=relpath, line=node.lineno,
+            symbol=fn.name,
+            message=f"host-sync call {name}() inside "
+                    f"@hot_path function {fn.name}",
+            fix_hint="move it off the dispatch path, or whitelist it "
+                     "at the annotation: @hot_path(allow=(...,)) with "
+                     "the reason in the surrounding code"))
+
+
+def _scan_static_args(relpath: str, tree: ast.Module,
+                      findings: List[Finding]) -> None:
+    static_of: Dict[str, Set[str]] = {}
+    # pass 1: jitted defs (decorator form) + jit-wrapping assignments
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    names = _jit_static_names(dec)
+                    if names:
+                        static_of[node.name] = names
+                        # a static param with a mutable default can
+                        # never be hashed at the default either
+                        args = node.args
+                        params = args.posonlyargs + args.args + \
+                            args.kwonlyargs
+                        defaults = ([None] * (len(args.posonlyargs)
+                                              + len(args.args)
+                                              - len(args.defaults))
+                                    + list(args.defaults)
+                                    + list(args.kw_defaults))
+                        for p, dflt in zip(params, defaults):
+                            if p.arg in names and isinstance(
+                                    dflt, _MUTABLE_DISPLAYS):
+                                findings.append(Finding(
+                                    checker="jax-hygiene",
+                                    path=relpath, line=node.lineno,
+                                    symbol=node.name,
+                                    message=f"static arg {p.arg!r} of "
+                                            f"jitted {node.name} has "
+                                            f"an unhashable default",
+                                    fix_hint="use a tuple / None"))
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            names = _jit_static_names(node.value)
+            if names and node.value.args and \
+                    isinstance(node.value.args[0], ast.Name):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        static_of[t.id] = names
+    if not static_of:
+        return
+    # pass 2: call sites passing mutable displays to static params
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Name):
+            continue
+        names = static_of.get(node.func.id)
+        if not names:
+            continue
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(kw.value,
+                                              _MUTABLE_DISPLAYS):
+                findings.append(Finding(
+                    checker="jax-hygiene", path=relpath,
+                    line=node.lineno, symbol=node.func.id,
+                    message=f"call passes an unhashable "
+                            f"{type(kw.value).__name__.lower()} to "
+                            f"static arg {kw.arg!r} of jitted "
+                            f"{node.func.id} — TypeError at trace "
+                            f"time (or a silent recompile per call)",
+                    fix_hint="pass a tuple / scalar; static args must "
+                             "hash stably across calls"))
+
+
+@checker("jax-hygiene",
+         "wall clocks, hot-path host syncs, unhashable static args")
+def check_jax(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath in ctx.py_files():
+        if not relpath.startswith("knn_tpu"):
+            continue  # scripts/bench are session drivers, out of scope
+        tree = ctx.parse(relpath)
+        if tree is None:
+            continue
+        # 1. wall-clock reads
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    _call_name(node.func) == "time.time":
+                findings.append(Finding(
+                    checker="jax-hygiene", path=relpath,
+                    line=node.lineno, symbol="time.time",
+                    message="wall-clock read time.time() — durations "
+                            "must come from perf_counter/monotonic",
+                    fix_hint="if this is a display timestamp that is "
+                             "never differenced, suppress with that "
+                             "justification"))
+        # 2. hot-path host syncs
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    allow = _hot_path_allow(dec)
+                    if allow is not None:
+                        _scan_hot_path(relpath, node, allow, findings)
+                        break
+        # 3. static-arg hygiene
+        _scan_static_args(relpath, tree, findings)
+    return findings

@@ -1,0 +1,113 @@
+"""``metric-lockstep`` — the PR-4 metric-name lint, rebuilt as a
+framework checker.
+
+Same three invariants ``scripts/lint_metric_names.py`` enforced since
+the telemetry subsystem landed (that script is now a thin shim over
+this checker, same exit codes):
+
+1. every catalog name (knn_tpu.obs.names.CATALOG — the only names the
+   registry will hand out) matches ``knn_tpu_[a-z0-9_]+``;
+2. every catalog name appears in the docs/OBSERVABILITY.md catalog
+   table — an instrumented path can't ship an undocumented metric;
+3. every metric-shaped literal in source is a catalog name (nobody
+   bypasses the names module inline — the registry would refuse it at
+   runtime; this catches it at lint time), and every doc mention
+   resolves to a catalog name modulo the Prometheus summary suffixes
+   ``_sum``/``_count``.
+
+The source scan is text-based (not AST) on purpose, preserving the
+original lint's semantics: a phantom metric in a comment or docstring
+misleads exactly like one in code.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+from knn_tpu.analysis.core import Context, Finding, checker
+
+TOKEN = re.compile(r"\bknn_tpu_[a-z0-9_]+\b")
+#: Prometheus renders histogram series with these suffixes; the doc may
+#: (and does) show them in examples
+SUFFIXES = ("_sum", "_count")
+
+DOC = os.path.join("docs", "OBSERVABILITY.md")
+
+#: the catalog itself, and the legacy shim (whose docstring names the
+#: invariants without being an instrumented path)
+_SKIP = {
+    os.path.join("knn_tpu", "obs", "names.py"),
+    os.path.join("scripts", "lint_metric_names.py"),
+}
+
+
+def _base(token: str, catalog) -> str:
+    for suf in SUFFIXES:
+        if token.endswith(suf) and token[: -len(suf)] in catalog:
+            return token[: -len(suf)]
+    return token
+
+
+@checker("metric-lockstep",
+         "metric catalog <-> registry regex <-> docs <-> source literals",
+         uses_ast=False)
+def check_metrics(ctx: Context) -> List[Finding]:
+    from knn_tpu.obs import names as _session_names
+    from knn_tpu.obs.registry import NAME_RE
+
+    # the lint root's own catalog when it carries one (see
+    # Context.load_module); the name GRAMMAR (NAME_RE) is the
+    # framework's own contract and stays the session's
+    CATALOG = ctx.load_module(
+        os.path.join("knn_tpu", "obs", "names.py"),
+        _session_names).CATALOG
+
+    findings: List[Finding] = []
+
+    def err(path: str, line: int, msg: str, symbol: str = "") -> None:
+        findings.append(Finding(checker="metric-lockstep", path=path,
+                                line=line, message=msg, symbol=symbol))
+
+    # 1. catalog names are well-formed
+    for name in CATALOG:
+        if not NAME_RE.match(name):
+            err(os.path.join("knn_tpu", "obs", "names.py"), 0,
+                f"catalog name {name!r} does not match "
+                f"{NAME_RE.pattern}", name)
+
+    # 2. every catalog name is documented
+    doc_tokens = set()
+    if ctx.exists(DOC):
+        doc_text = ctx.read(DOC)
+        doc_tokens = set(TOKEN.findall(doc_text))
+        for name in CATALOG:
+            if name not in doc_tokens:
+                err(DOC, 0,
+                    f"{name} is registrable but missing from "
+                    f"docs/OBSERVABILITY.md", name)
+        # 3a. doc tokens resolve to catalog names (no phantom metrics)
+        for token in sorted(doc_tokens):
+            if _base(token, CATALOG) not in CATALOG:
+                err(DOC, 0,
+                    f"docs/OBSERVABILITY.md mentions {token}, which is "
+                    f"not a catalog metric", token)
+
+    # 3b. source literals resolve to catalog names (no catalog bypass).
+    # tokens ending in "_" are prefixes (docstring brace shorthand,
+    # tempdir prefixes), not metric names — a real metric never ends in
+    # underscore.
+    for relpath in ctx.py_files():
+        if relpath in _SKIP:
+            continue
+        for i, line in enumerate(ctx.read(relpath).splitlines(), 1):
+            for token in TOKEN.findall(line):
+                if token.endswith("_"):
+                    continue
+                if _base(token, CATALOG) not in CATALOG:
+                    err(relpath, i,
+                        f"literal {token} is not a catalog metric "
+                        f"(declare it in knn_tpu/obs/names.py, with "
+                        f"docs, before instrumenting)", token)
+    return findings

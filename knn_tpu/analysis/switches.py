@@ -1,0 +1,296 @@
+"""The env-switch catalog — ONE jax-free home for every ``KNN_TPU_*`` /
+``KNN_BENCH_*`` environment switch the repo reads.
+
+The metric catalog (knn_tpu.obs.names) proved the pattern: declare every
+name centrally, lint source/docs/tests against the declaration, and an
+undeclared name can never ship half-wired.  Switches had no such home —
+PR 9 left ~65 switch literals scattered over bench/serving/obs/tuning
+with only 13 isolated by ``tests/conftest.py``, so an ambient developer
+shell could silently steer most of the suite.  This catalog closes
+that: every switch is declared here with its consumer, kind, and doc
+location, ``tests/conftest.py`` GENERATES its isolation list from
+:func:`isolation_names` (never hand-listed again), and the
+``switch-lockstep`` checker (knn_tpu.analysis.check_switches) enforces
+
+1. every switch-shaped string literal in source is declared here (or
+   is a declared family prefix),
+2. every declared switch appears in the docs (``docs/*.md`` or
+   ``README.md``),
+3. every declared switch is actually consumed by source (no phantom
+   rows; ``reserved`` families exempt),
+4. ``tests/conftest.py`` derives its isolation from this catalog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+#: the shape every switch name (and family prefix) must have; the
+#: checker also uses it to find switch-shaped literals in source
+SWITCH_RE = re.compile(r"^KNN_(TPU|BENCH)_[A-Z0-9_]*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Switch:
+    """One declared environment switch.
+
+    ``isolate=True`` (the default) means an ambient value steers
+    behavior tests assume defaulted, so conftest must scrub it from the
+    environment before the suite runs.  ``family=True`` declares a
+    PREFIX (name ends with ``_``): source may hold the prefix literal
+    (``env.startswith(...)`` scans) and conftest scrubs every ambient
+    variable under it.  ``reserved=True`` exempts a family from the
+    must-be-consumed check (namespace held for isolation only)."""
+
+    name: str
+    kind: str  # "flag" | "int" | "float" | "str" | "path" | "spec"
+    consumer: str  # module that reads it
+    doc: str  # the doc file its row lives in
+    description: str
+    isolate: bool = True
+    family: bool = False
+    reserved: bool = False
+
+
+def _s(name, kind, consumer, doc, description, **kw) -> Switch:
+    return Switch(name, kind, consumer, doc, description, **kw)
+
+
+#: every declared switch, grouped by owner subsystem.  Descriptions are
+#: one-liners; the doc file carries the full story.
+_OBS = "docs/OBSERVABILITY.md"
+_PERF = "docs/PERF.md"
+_SERVING = "docs/serving.md"
+
+SWITCHES: Tuple[Switch, ...] = (
+    # --- root namespaces (prefix scans + conftest scrubbing) -----------
+    _s("KNN_TPU_", "family", "knn_tpu/obs/blackbox.py", _OBS,
+       "Root library-switch namespace: the flight recorder captures "
+       "every member into postmortem bundles, and conftest scrubs any "
+       "ambient member before the suite runs.", family=True,
+       reserved=True),
+    _s("KNN_BENCH_", "family", "bench.py", _PERF,
+       "Root bench-switch namespace (same capture/scrub contract).",
+       family=True, reserved=True),
+    # --- telemetry / obs (knn_tpu.obs) ---------------------------------
+    _s("KNN_TPU_OBS", "flag", "knn_tpu/obs/registry.py", _OBS,
+       "0/false/off disables the telemetry subsystem (default on)."),
+    _s("KNN_TPU_OBS_LOG", "path", "knn_tpu/obs/trace.py", _OBS,
+       "JSONL sink for structured events (spans, alerts)."),
+    _s("KNN_TPU_OBS_LOG_MAX_BYTES", "int", "knn_tpu/obs/trace.py", _OBS,
+       "Rotation cap for the JSONL sink (default 64 MiB)."),
+    _s("KNN_TPU_SLO_CONFIG", "path", "knn_tpu/obs/slo.py", _OBS,
+       "JSON objective list replacing the default SLOs."),
+    _s("KNN_TPU_PROFILE_DIR", "path", "knn_tpu/obs/profiler.py", _OBS,
+       "Ambient device-trace gate: bench/tune winners capture one "
+       "jax.profiler.trace run here."),
+    _s("KNN_TPU_POSTMORTEM_DIR", "path", "knn_tpu/obs/blackbox.py", _OBS,
+       "Arms the flight recorder: one postmortem bundle per "
+       "edge-triggered SLO breach."),
+    _s("KNN_TPU_POSTMORTEM_KEEP", "int", "knn_tpu/obs/blackbox.py", _OBS,
+       "Postmortem bundle retention cap (default 8)."),
+    # --- tuning (knn_tpu.tuning) ---------------------------------------
+    _s("KNN_TPU_TUNE_CACHE", "path", "knn_tpu/tuning/cache.py", _PERF,
+       "Autotuner winner-cache file (default "
+       "~/.cache/knn_tpu/autotune.json)."),
+    _s("KNN_TPU_TUNE_PRUNE", "float", "knn_tpu/tuning/autotune.py", _OBS,
+       "Roofline-model candidate-pruning fraction in (0, 1]; unset = "
+       "exhaustive search."),
+    # --- certified pipeline overlap (knn_tpu.parallel.sharded) ---------
+    _s("KNN_TPU_PIPELINE_OVERLAP", "flag", "knn_tpu/parallel/sharded.py",
+       _OBS, "1 runs search_certified as the two-stage coarse/rescore "
+       "pipeline (bitwise-identical results)."),
+    _s("KNN_TPU_PIPELINE_DEPTH", "int", "knn_tpu/parallel/sharded.py",
+       _OBS, "Bounded in-flight batch depth of the pipelined path "
+       "(default 2)."),
+    # --- admission control (knn_tpu.serving.admission) -----------------
+    _s("KNN_TPU_ADMISSION_", "family", "knn_tpu/serving/admission.py",
+       _SERVING, "Admission-control knob family (ANY set member is an "
+       "opt-in; a typo'd member raises).", family=True),
+    _s("KNN_TPU_ADMISSION_MAX_DEPTH", "int",
+       "knn_tpu/serving/admission.py", _SERVING,
+       "Bounded outstanding-work depth (explicit rejection past it)."),
+    _s("KNN_TPU_ADMISSION_SHED", "flag", "knn_tpu/serving/admission.py",
+       _SERVING, "Deadline-aware load shedding at submit and dispatch."),
+    _s("KNN_TPU_ADMISSION_DEFAULT_DEADLINE_MS", "float",
+       "knn_tpu/serving/admission.py", _SERVING,
+       "Deadline applied to requests that don't carry one."),
+    _s("KNN_TPU_ADMISSION_QUOTAS", "spec",
+       "knn_tpu/serving/admission.py", _SERVING,
+       "Per-tenant token-bucket quotas, tenant:rate[:burst],..."),
+    _s("KNN_TPU_ADMISSION_PRIORITIES", "spec",
+       "knn_tpu/serving/admission.py", _SERVING,
+       "Per-tenant dispatch priorities, tenant:level,..."),
+    _s("KNN_TPU_ADMISSION_AGING_MS", "float",
+       "knn_tpu/serving/admission.py", _SERVING,
+       "Priority aging constant (starvation safety)."),
+    # --- loadgen (namespace reserved; all config is flags/args today) --
+    _s("KNN_TPU_LOADGEN_", "family", "knn_tpu/loadgen/", _SERVING,
+       "Reserved loadgen namespace — scrubbed by conftest so future "
+       "knobs are isolated from day one.", family=True, reserved=True),
+    # --- bench.py: problem shape & run shape ---------------------------
+    _s("KNN_BENCH_CONFIG", "str", "bench.py", _PERF,
+       "Named benchmark config: sift1m (default) | glove | gist1m."),
+    _s("KNN_BENCH_MODES", "spec", "bench.py", _PERF,
+       "Comma list of modes to run (exact, certified_approx, "
+       "certified_pallas, serving, knee)."),
+    _s("KNN_BENCH_RUNS", "int", "bench.py", _PERF,
+       "Timed repetitions per mode (default 5)."),
+    _s("KNN_BENCH_N", "int", "bench.py", _PERF, "Database rows."),
+    _s("KNN_BENCH_DIM", "int", "bench.py", _PERF, "Feature dim."),
+    _s("KNN_BENCH_K", "int", "bench.py", _PERF, "Neighbor count."),
+    _s("KNN_BENCH_METRIC", "str", "bench.py", _PERF,
+       "Distance metric of the synthetic config."),
+    _s("KNN_BENCH_NQ", "int", "bench.py", _PERF, "Query count."),
+    _s("KNN_BENCH_BATCH", "int", "bench.py", _PERF,
+       "Queries per device step."),
+    _s("KNN_BENCH_TILE", "int", "bench.py", _PERF,
+       "HBM train-tile rows for the streamed distance matrix."),
+    _s("KNN_BENCH_CPU_QUERIES", "int", "bench.py", _PERF,
+       "Query count of the CPU-oracle pass."),
+    _s("KNN_BENCH_MARGIN", "int", "bench.py", _PERF,
+       "Certified-mode candidate margin."),
+    _s("KNN_BENCH_DTYPE", "str", "bench.py", _PERF,
+       "Placement compute dtype (bfloat16 | float32)."),
+    # --- bench.py: environment/bring-up --------------------------------
+    _s("KNN_BENCH_PLATFORM", "str", "bench.py", _PERF,
+       "Force a JAX platform (e.g. cpu) instead of auto-detect."),
+    _s("KNN_BENCH_PEAK_FLOPS", "float", "bench.py", _PERF,
+       "Override the per-chip peak FLOP/s used for MFU."),
+    _s("KNN_BENCH_INIT_TIMEOUT", "int", "bench.py", _PERF,
+       "Seconds before backend init is declared hung (default 480)."),
+    _s("KNN_BENCH_INIT_ATTEMPTS", "int", "bench.py", _PERF,
+       "Backend-init retry attempts."),
+    _s("KNN_BENCH_INIT_WAIT", "int", "bench.py", _PERF,
+       "Seconds between backend-init retries."),
+    _s("KNN_BENCH_FALLBACK_CPU", "flag", "bench.py", _PERF,
+       "Run on CPU when accelerator init fails (default on)."),
+    _s("KNN_BENCH_CPU_CACHE", "flag", "bench.py", _PERF,
+       "0 forces a fresh CPU-oracle measurement instead of the cached "
+       "one."),
+    _s("KNN_BENCH_GATE", "flag", "bench.py", _PERF,
+       "0 skips the exactness gate on huge dims."),
+    _s("KNN_BENCH_VERBOSE", "flag", "bench.py", _PERF,
+       "1 prints stage progress on stderr."),
+    _s("KNN_BENCH_TRACE", "path", "bench.py", _PERF,
+       "Write a jax.profiler trace of one extra per-mode run here."),
+    _s("KNN_BENCH_TUNE_CACHE", "path", "bench.py", _PERF,
+       "Autotuner cache the bench resolves knobs through."),
+    _s("KNN_BENCH_OBS_OVERHEAD", "flag", "bench.py", _PERF,
+       "1 A/Bs the serving sweep with telemetry off/on and emits "
+       "obs_overhead_pct."),
+    # --- bench.py: XLA-selector knobs ----------------------------------
+    _s("KNN_BENCH_APPROX_RT", "float", "bench.py", _PERF,
+       "ApproxTopK recall target of the certified_approx mode."),
+    _s("KNN_BENCH_APPROX_MARGIN", "int", "bench.py", _PERF,
+       "Margin override of the certified_approx mode."),
+    # --- bench.py: pallas knob overrides (unset = tuned/default) -------
+    _s("KNN_BENCH_PALLAS_", "family", "bench.py", _PERF,
+       "Pallas knob-override family; unset members resolve through the "
+       "autotuner cache.", family=True),
+    _s("KNN_BENCH_PALLAS_PRECISION", "str", "bench.py", _PERF,
+       "Kernel matmul precision (bf16x3 | bf16x3f | int8 | highest)."),
+    _s("KNN_BENCH_PALLAS_TILE", "int", "bench.py", _PERF,
+       "Kernel db tile rows (tile_n)."),
+    _s("KNN_BENCH_PALLAS_BIN_W", "int", "bench.py", _PERF,
+       "Kernel bin width."),
+    _s("KNN_BENCH_PALLAS_SURVIVORS", "int", "bench.py", _PERF,
+       "Per-bin survivor count."),
+    _s("KNN_BENCH_PALLAS_BLOCK_Q", "int", "bench.py", _PERF,
+       "Query block rows (block_q)."),
+    _s("KNN_BENCH_PALLAS_FINAL", "str", "bench.py", _PERF,
+       "Final select: exact | approx."),
+    _s("KNN_BENCH_PALLAS_FINAL_RT", "float", "bench.py", _PERF,
+       "Approx final-select recall target."),
+    _s("KNN_BENCH_PALLAS_BINNING", "str", "bench.py", _PERF,
+       "Binning strategy: grouped | lane."),
+    _s("KNN_BENCH_PALLAS_GRID", "str", "bench.py", _PERF,
+       "Grid order: query_major | db_major."),
+    _s("KNN_BENCH_PALLAS_KERNEL", "str", "bench.py", _PERF,
+       "Db-streaming strategy: tiled | streaming | fused."),
+    _s("KNN_BENCH_PALLAS_BATCH", "int", "bench.py", _PERF,
+       "Queries per kernel launch in the pallas mode."),
+    # --- bench.py: serving sweep ---------------------------------------
+    _s("KNN_BENCH_SERVING_REQUESTS", "int", "bench.py", _PERF,
+       "Replayed request count of the serving mode."),
+    _s("KNN_BENCH_SERVING_DEPTH", "int", "bench.py", _PERF,
+       "Dispatch-ahead depth of the serving mode."),
+    _s("KNN_BENCH_SERVING_MIN_BUCKET", "int", "bench.py", _PERF,
+       "Smallest bucket rung of the serving mode's ladder."),
+    # --- bench.py: knee sweep ------------------------------------------
+    _s("KNN_BENCH_KNEE_", "family", "bench.py", _PERF,
+       "Knee-sweep knob family of the opt-in knee mode.", family=True),
+    _s("KNN_BENCH_KNEE_RATES", "spec", "bench.py", _PERF,
+       "Offered-rate ladder, comma-separated q/s."),
+    _s("KNN_BENCH_KNEE_STEP_S", "float", "bench.py", _PERF,
+       "Seconds per rate step."),
+    _s("KNN_BENCH_KNEE_SLO_MS", "float", "bench.py", _PERF,
+       "Admitted-p99 bound defining the knee."),
+    _s("KNN_BENCH_KNEE_TENANTS", "spec", "bench.py", _PERF,
+       "Tenant mix spec, name[:weight[:priority]],..."),
+    _s("KNN_BENCH_KNEE_SEED", "int", "bench.py", _PERF,
+       "Workload-schedule seed."),
+)
+
+#: name -> Switch for exact lookups
+BY_NAME: Dict[str, Switch] = {s.name: s for s in SWITCHES}
+
+#: declared family prefixes (names ending in ``_``)
+FAMILY_PREFIXES: Tuple[str, ...] = tuple(
+    s.name for s in SWITCHES if s.family)
+
+
+def _validate() -> None:
+    for s in SWITCHES:
+        if not SWITCH_RE.match(s.name):
+            raise ValueError(f"switch {s.name!r} does not match "
+                             f"{SWITCH_RE.pattern}")
+        if s.family != s.name.endswith("_"):
+            raise ValueError(
+                f"switch {s.name!r}: family declarations (and only "
+                f"those) must end with '_'")
+    if len(BY_NAME) != len(SWITCHES):
+        raise ValueError("duplicate switch declarations")
+
+
+_validate()
+
+
+def lookup(token: str) -> Optional[Switch]:
+    """The declaration covering ``token``: an exact catalog row, or the
+    family row when ``token`` IS a declared prefix.  A concrete member
+    of a family must still be declared individually — the family only
+    legitimizes prefix literals (startswith scans) and conftest
+    scrubbing, never an undeclared concrete switch."""
+    hit = BY_NAME.get(token)
+    if hit is not None:
+        return hit
+    if token.endswith("_") and token in FAMILY_PREFIXES:
+        return BY_NAME[token]
+    return None
+
+
+def isolation_names(environ: Optional[Mapping[str, str]] = None
+                    ) -> List[str]:
+    """The environment-variable names ``tests/conftest.py`` must scrub
+    before the suite runs: every concrete cataloged switch with
+    ``isolate=True``, plus any AMBIENT variable (from ``environ``)
+    under an isolated family prefix — so a developer shell's
+    ``KNN_BENCH_PALLAS_WHATEVER=...`` is scrubbed even before it gets
+    its own catalog row.  Generated, never hand-listed: a new catalog
+    row is isolated on the next test run with zero conftest edits."""
+    names = [s.name for s in SWITCHES if s.isolate and not s.family]
+    if environ:
+        prefixes = tuple(s.name for s in SWITCHES
+                         if s.family and s.isolate)
+        names.extend(k for k in environ
+                     if k.startswith(prefixes) and k not in names)
+    return sorted(set(names))
+
+
+def tokens_in_source(text: str) -> Iterable[str]:
+    """Every switch-shaped token in ``text`` (used by the checker over
+    docs; source literals go through the AST instead)."""
+    return re.findall(r"\bKNN_(?:TPU|BENCH)_[A-Z0-9_]*\b", text)

@@ -1,0 +1,270 @@
+"""Analytic per-launch VMEM model of the Pallas kernels — the budget
+side of the roofline story.
+
+The roofline model (knn_tpu.obs.roofline) prices a knob set's TIME;
+nothing priced its per-launch VMEM footprint, yet VMEM is the binding
+resource that decides whether a config RUNS AT ALL: an over-VMEM knob
+combination fails at Mosaic compile time, on hardware, at the worst
+possible moment (mid-tune on a TPU session).  ``ops.pallas_knn``
+already computes per-launch byte budgets inline to size its
+``vmem_limit_bytes`` compiler hints — this module lifts the SAME
+arithmetic into a jax-free home so
+
+- ``autotune()`` can refuse (or flag) over-budget candidates BEFORE
+  timing, with provenance recorded like roofline pruning,
+- the ``vmem-budget`` checker (knn_tpu.analysis.check_vmem) can prove
+  statically that the default knobs fit the target device and that the
+  knob grid carries no candidate that fits NO known device,
+- ``knob_grid`` can bound its enumeration to configurations that fit
+  at least one known device kind at the headline shape.
+
+Geometry constants mirror ``ops.pallas_knn`` (TILE_N/BLOCK_Q/BIN_W/
+DIM_CHUNK/MAX_CARRY_DEPTH) and operand widths mirror
+``obs.roofline.DB_ELEM_BYTES`` — tests/test_analysis.py pins both
+mirrors against the source modules, the same lockstep discipline the
+roofline module uses.
+
+Capacity provenance: TPU v2/v3 cores carry ~16 MiB of VMEM; v4 and
+every later announced generation carry 128 MiB (the number
+``ops.pallas_knn``'s tiled-path comment already relies on for v5e).
+An unknown TPU kind gets the 128 MiB default flagged ``estimated``;
+CPU backends have no VMEM and are never budget-checked.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+#: mirrors of ops.pallas_knn geometry constants (pinned by test)
+TILE_N_DEFAULT = 16384
+BLOCK_Q_DEFAULT = 128
+BIN_W = 128
+DIM_CHUNK = 128
+MAX_CARRY_DEPTH = 8
+SURVIVORS_GROUPED_DEFAULT = 2
+
+#: db operand parts per precision: (n_parts, chunk_w, bytes/elem) —
+#: what one db block of ONE part occupies ((tile_n, chunk_w) at the
+#: part dtype); mirrors ops.pallas_knn._bin_candidates
+DB_PARTS: Dict[str, Tuple[int, int, int]] = {
+    "bf16x3": (2, DIM_CHUNK, 2),
+    "bf16x3f": (1, 3 * DIM_CHUNK, 2),
+    "int8": (1, DIM_CHUNK, 1),
+    "highest": (1, DIM_CHUNK, 4),
+    "default": (1, DIM_CHUNK, 4),
+}
+
+#: f32 sublane rows of the aux (norms / norms+scales) block
+AUX_ROWS: Dict[str, int] = {"int8": 16}
+AUX_ROWS_DEFAULT = 8
+
+#: per-device-kind VMEM capacity in bytes (see module docstring)
+MIB = 1024 * 1024
+VMEM_BYTES_BY_KIND: Dict[str, int] = {
+    "TPU v2": 16 * MIB,
+    "TPU v3": 16 * MIB,
+    "TPU v4": 128 * MIB,
+    "TPU v4i": 128 * MIB,
+    "TPU v5 lite": 128 * MIB,
+    "TPU v5e": 128 * MIB,
+    "TPU v5": 128 * MIB,
+    "TPU v5p": 128 * MIB,
+    "TPU v6 lite": 128 * MIB,
+    "TPU v6e": 128 * MIB,
+    "TPU v6": 128 * MIB,
+    "TPU v6p": 128 * MIB,
+    "TPU v7": 128 * MIB,
+    "TPU v7x": 128 * MIB,
+}
+DEFAULT_VMEM_BYTES = 128 * MIB
+
+#: the repo's target hardware (every headline number is v5e) and the
+#: headline problem shape (SIFT1M) the static checker prices at
+TARGET_DEVICE_KIND = "TPU v5e"
+HEADLINE_SHAPE = {"n": 1_000_000, "d": 128, "k": 100, "margin": 28}
+
+
+def budget_for(device_kind: Optional[str],
+               backend: Optional[str] = None
+               ) -> Tuple[Optional[int], bool]:
+    """(vmem bytes, estimated) for a device kind; (None, False) when
+    there is no VMEM to budget (cpu / interpret mode / unknown
+    non-TPU backend) — the autotuner's gate disarms there instead of
+    refusing on a number that doesn't exist.  An explicit TPU
+    ``device_kind`` wins over ``backend``: a caller modeling (or
+    keying a cache for) a specific chip gets that chip's budget even
+    when the tune itself runs in CPU interpret mode."""
+    if device_kind in VMEM_BYTES_BY_KIND:
+        return VMEM_BYTES_BY_KIND[device_kind], False
+    if str(device_kind or "").startswith("TPU"):
+        return DEFAULT_VMEM_BYTES, True
+    if device_kind is None and str(backend or "").lower() == "tpu":
+        # TPU backend whose device-kind string is unavailable: the
+        # backend evidence says there IS a VMEM to overflow, so arm the
+        # gate at the unknown-kind default rather than disarming on
+        # missing metadata
+        return DEFAULT_VMEM_BYTES, True
+    return None, False
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+def _geometry(n: int, d: int, precision: str, kernel: str,
+              tile_n: Optional[int], block_q: Optional[int],
+              survivors: Optional[int], binning: str):
+    if precision not in DB_PARTS:
+        raise ValueError(
+            f"precision {precision!r} not in {sorted(DB_PARTS)}")
+    tile = int(tile_n or TILE_N_DEFAULT)
+    # the kernel pads the db to a tile multiple; an oversize tile caps
+    # at the padded row count (mirrors obs.roofline's clamp)
+    tile = min(tile, max(BIN_W, _ceil_div(n, BIN_W) * BIN_W))
+    bq = int(block_q or BLOCK_Q_DEFAULT)
+    n_tiles = _ceil_div(n, tile)
+    dim_p = _ceil_div(d, DIM_CHUNK) * DIM_CHUNK
+    nd = dim_p // DIM_CHUNK
+    if binning == "grouped":
+        surv = int(survivors or SURVIVORS_GROUPED_DEFAULT)
+        out_w = surv * BIN_W
+        bound_w = BIN_W
+    else:
+        surv = int(survivors or 2)
+        n_bins = max(1, tile // BIN_W)
+        out_w = _ceil_div(n_bins * surv, BIN_W) * BIN_W
+        bound_w = _ceil_div(n_bins, BIN_W) * BIN_W
+    return tile, bq, n_tiles, dim_p, nd, out_w, bound_w
+
+
+def launch_estimate(
+    *, n: int, d: int, k: int, margin: int = 28,
+    precision: Optional[str] = None, kernel: Optional[str] = None,
+    tile_n: Optional[int] = None, block_q: Optional[int] = None,
+    survivors: Optional[int] = None, binning: Optional[str] = None,
+) -> dict:
+    """Estimated VMEM high-water bytes of ONE kernel launch for this
+    knob set, with the per-buffer breakdown.
+
+    Mirrors the budgets ``ops.pallas_knn`` computes when sizing its
+    ``vmem_limit_bytes`` hints, plus the pipelined double-buffering of
+    grid-mapped blocks the compiler adds on top:
+
+    - **tiled**: pipeline inputs/outputs are double-buffered block
+      specs (db tile parts, aux rows, query block, candidate outputs);
+      the [block_q, tile_n] score tile (and the multi-chunk int32/f32
+      accumulator scratch) live once.
+    - **streaming/fused**: the kernel OWNS its double buffering — two
+      explicit scratch slots per db part + aux — and carries the
+      full-width candidate output block in VMEM for the whole launch;
+      the fused arm adds its per-lane order-statistic carry
+      (``ceil((m+2)/128)`` stats per lane, disarmed past
+      MAX_CARRY_DEPTH).
+    """
+    precision = precision or "bf16x3"
+    kernel = kernel or "tiled"
+    binning = binning or "grouped"
+    if kernel not in ("tiled", "streaming", "fused"):
+        raise ValueError(
+            f"kernel {kernel!r} not in ('tiled', 'streaming', 'fused')")
+    tile, bq, n_tiles, dim_p, nd, out_w, bound_w = _geometry(
+        n, d, precision, kernel, tile_n, block_q, survivors, binning)
+    n_parts, chunk_w, part_b = DB_PARTS[precision]
+    aux_rows = AUX_ROWS.get(precision, AUX_ROWS_DEFAULT)
+    q_elem = 1 if precision == "int8" else 4
+    q_extra_b = bq * BIN_W * 4 if precision == "int8" else 0
+
+    db_block = n_parts * tile * chunk_w * part_b
+    aux_block = aux_rows * tile * 4
+    score = bq * tile * 4
+    accum = bq * tile * 4 if nd > 1 else 0
+
+    if kernel == "tiled":
+        q_block = bq * DIM_CHUNK * q_elem
+        out_block = bq * (out_w * 8 + bound_w * 4)
+        inputs = db_block + aux_block + q_block + q_extra_b
+        total = 2 * inputs + 2 * out_block + score + accum
+        breakdown = {
+            "db_blocks_x2": 2 * db_block,
+            "aux_x2": 2 * aux_block,
+            "query_x2": 2 * (q_block + q_extra_b),
+            "outputs_x2": 2 * out_block,
+            "score_tile": score,
+            "accum_scratch": accum,
+        }
+    else:
+        q_block = bq * dim_p * q_elem
+        out_block = bq * (2 * n_tiles * out_w + n_tiles * bound_w) * 4
+        buf = 2 * (db_block + aux_block)  # the explicit scratch slots
+        carry = 0
+        if kernel == "fused":
+            keep = min(int(k) + int(margin), max(1, int(n) - 1)) + 2
+            depth = _ceil_div(keep, BIN_W)
+            if depth <= MAX_CARRY_DEPTH:
+                carry = bq * depth * BIN_W * 8  # f32 stats + i32 ids
+        total = out_block + buf + 2 * score + accum + \
+            2 * (q_block + q_extra_b) + carry
+        breakdown = {
+            "outputs_fullwidth": out_block,
+            "stream_scratch_x2": buf,
+            "score_tile_x2": 2 * score,
+            "accum_scratch": accum,
+            "query_x2": 2 * (q_block + q_extra_b),
+            "fused_carry": carry,
+        }
+    return {
+        "total_bytes": int(total),
+        "breakdown": {kk: int(v) for kk, v in breakdown.items()},
+        "geometry": {
+            "tile_n": tile, "block_q": bq, "n_tiles": n_tiles,
+            "dim_padded": dim_p, "out_w": out_w, "bound_w": bound_w,
+            "kernel": kernel, "precision": precision,
+        },
+    }
+
+
+def check_candidate(
+    knobs: dict, *, n: int, d: int, k: int, margin: int = 28,
+    device_kind: Optional[str] = None, backend: Optional[str] = None,
+) -> dict:
+    """Price one knob set against one device kind's VMEM:
+    ``{"checked", "fits", "estimate_bytes", "budget_bytes", ...}``.
+    ``checked=False`` (cpu / no-VMEM backend) means the verdict is
+    N/A, never a refusal."""
+    budget, estimated = budget_for(device_kind, backend)
+    est = launch_estimate(
+        n=n, d=d, k=k, margin=margin,
+        precision=knobs.get("precision"), kernel=knobs.get("kernel"),
+        tile_n=knobs.get("tile_n"), block_q=knobs.get("block_q"),
+        survivors=knobs.get("survivors"), binning=knobs.get("binning"))
+    out = {
+        "checked": budget is not None,
+        "estimate_bytes": est["total_bytes"],
+        "budget_bytes": budget,
+        "device_kind": device_kind,
+        "estimated_budget": estimated,
+        "fits": None if budget is None
+        else est["total_bytes"] <= budget,
+    }
+    return out
+
+
+def fits_some_kind(knobs: dict, *, n: int, d: int, k: int,
+                   margin: int = 28) -> bool:
+    """Whether the knob set fits AT LEAST ONE known device kind's VMEM
+    at this shape.  A candidate that fits nowhere is dead grid weight:
+    on every real device the autotuner's budget gate would refuse it,
+    so enumerating it only burns model time and review attention —
+    ``knob_grid`` excludes such combinations at the headline shape and
+    the ``vmem-budget`` checker enforces the same bound."""
+    try:
+        est = launch_estimate(
+            n=n, d=d, k=k, margin=margin,
+            precision=knobs.get("precision"),
+            kernel=knobs.get("kernel"), tile_n=knobs.get("tile_n"),
+            block_q=knobs.get("block_q"),
+            survivors=knobs.get("survivors"),
+            binning=knobs.get("binning"))["total_bytes"]
+    except ValueError:
+        return True  # unpriceable: never exclude on a model gap
+    return est <= max(VMEM_BYTES_BY_KIND.values())

@@ -66,6 +66,17 @@ bundle (``KNN_TPU_POSTMORTEM_DIR``), a JSONL event log (the rotated
 ``/waterfallz`` endpoint.  Jax-free by construction
 (docs/OBSERVABILITY.md "Waterfalls & exemplars").
 
+    python -m knn_tpu.cli lint [--json] [--checker NAME]
+
+runs the repo-native static-analysis suite (knn_tpu.analysis,
+docs/ANALYSIS.md) over the source tree, jax-free: env-switch and
+metric-name lockstep, locked-mutation (thread-safety contracts),
+jax-hygiene (wall clocks, hot-path host syncs, unhashable static
+args), and the VMEM knob-grid budget.  Exit 0 green — with every
+suppression in knn_tpu/analysis/suppressions.json carrying a written
+justification — 1 findings.  ``check_tier1.sh --fast`` runs it as a
+hard gate.
+
     python -m knn_tpu.cli loadgen --synthetic 500 --slo-p99-ms 20
     python -m knn_tpu.cli loadgen --n 100000 --dim 64 --rates 50,100,200 \\
         --max-depth 64 --shed --deadline-ms 250 --tenants gold:3,free:1
@@ -861,6 +872,63 @@ def run_loadgen(args: argparse.Namespace) -> int:
     return 0
 
 
+def build_lint_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu lint",
+        description="Run the repo-native static-analysis suite "
+        "(knn_tpu.analysis — docs/ANALYSIS.md): switch/metric lockstep, "
+        "locked-mutation, jax-hygiene, and VMEM-budget checkers over "
+        "the source tree, jax-free.  Exit 0 green (every suppression "
+        "justified), 1 findings (or a broken/stale suppression file).",
+    )
+    p.add_argument("--root", default=None, metavar="DIR",
+                   help="tree to lint (default: the repo this package "
+                   "is imported from); a root carrying its own "
+                   "switch/metric catalogs is judged against those "
+                   "(vmem-budget always prices the imported package's "
+                   "knob grid)")
+    p.add_argument("--checker", action="append", default=None,
+                   metavar="NAME",
+                   help="run only this checker (repeatable; default "
+                   "all; see --list)")
+    p.add_argument("--list", action="store_true",
+                   help="list the registered checkers and exit")
+    p.add_argument("--json", action="store_true",
+                   help="print the full report as ONE JSON document "
+                   "instead of the text rendering")
+    return p
+
+
+def run_lint(args: argparse.Namespace) -> int:
+    """The `lint` subcommand — jax-free by construction (knn_tpu.analysis
+    parses source with stdlib ``ast``; it never imports the code it
+    inspects, only the jax-free declaration catalogs): the CI tripwire
+    must not pay a backend init."""
+    import json
+    import os
+
+    from knn_tpu import analysis
+
+    if args.list:
+        for name, (_fn, desc) in analysis.CHECKERS.items():
+            print(f"{name:<16} {desc}")
+        return 0
+    root = args.root
+    if root is None:
+        # knn_tpu/cli.py -> knn_tpu/ -> the repo root
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        report = analysis.run(root, names=args.checker)
+    except ValueError as e:  # unknown --checker name
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report.as_dict(), indent=1, sort_keys=True))
+    else:
+        sys.stdout.write(report.render_text())
+    return 0 if report.ok else 1
+
+
 def args_to_config(args: argparse.Namespace) -> JobConfig:
     return JobConfig(
         train_file=args.train,
@@ -902,6 +970,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
             request_cpu_devices(targs.cpu_devices)
         return run_tune(targs)
+    if argv[:1] == ["lint"]:
+        return run_lint(build_lint_parser().parse_args(argv[1:]))
     if argv[:1] == ["metrics"]:
         return run_metrics(build_metrics_parser().parse_args(argv[1:]))
     if argv[:1] == ["doctor"]:

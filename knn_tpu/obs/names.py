@@ -62,6 +62,8 @@ TUNING_SEARCHES = "knn_tpu_tuning_searches_total"
 TUNING_CANDIDATES_TIMED = "knn_tpu_tuning_candidates_timed_total"
 TUNING_GATE_FAILURES = "knn_tpu_tuning_gate_failures_total"
 TUNING_CANDIDATES_PRUNED = "knn_tpu_tuning_candidates_pruned_total"
+TUNING_CANDIDATES_VMEM_REFUSED = \
+    "knn_tpu_tuning_candidates_vmem_refused_total"
 
 # --- certified pipeline overlap (knn_tpu.parallel.sharded) -------------
 PIPELINE_OVERLAP_RATIO = "knn_tpu_pipeline_overlap_ratio"
@@ -219,6 +221,12 @@ CATALOG = {
         "counter", (), "Autotuner candidates skipped before timing by "
         "the roofline-model pruning gate (KNN_TPU_TUNE_PRUNE; every "
         "skip is recorded in the tune entry's pruning provenance)."),
+    TUNING_CANDIDATES_VMEM_REFUSED: (
+        "counter", (), "Autotuner candidates refused before timing by "
+        "the analytic VMEM budget gate (knn_tpu.analysis.vmem): their "
+        "estimated per-launch footprint exceeds the device kind's VMEM, "
+        "so they would fail at Mosaic compile time; every refusal is "
+        "recorded in the tune entry's vmem provenance."),
     PIPELINE_OVERLAP_RATIO: (
         "gauge", (),
         "Fraction of the last certified pipeline-overlap run's wall "

@@ -56,7 +56,8 @@ EXEMPLAR_MAX_AGE_S = 600.0
 
 
 class Counter:
-    """Monotone counter; ``inc`` only (negative increments refused)."""
+    """Monotone counter; ``inc`` only (negative increments refused).
+    Thread-safety: guarded by ``self._lock``."""
 
     __slots__ = ("_lock", "_v")
 
@@ -76,7 +77,8 @@ class Counter:
 
 
 class Gauge:
-    """Settable level; ``set``/``inc``/``dec``."""
+    """Settable level; ``set``/``inc``/``dec``.
+    Thread-safety: guarded by ``self._lock``."""
 
     __slots__ = ("_lock", "_v")
 
@@ -104,6 +106,9 @@ class Gauge:
 class Histogram:
     """Lifetime count/sum/min/max + a bounded recent-sample window the
     percentiles are computed over (see module docstring).
+
+    Thread-safety: guarded by ``self._lock`` (machine-checked by the
+    ``locked-mutation`` checker, knn_tpu.analysis).
 
     ``observe(value, exemplar=trace_id)`` additionally retains the
     trace ids of the WORST recent samples (at most :data:`EXEMPLAR_CAP`,
@@ -133,7 +138,8 @@ class Histogram:
         self._ex: list = []
 
     def _note_exemplar(self, v: float, trace_id: str, mono: float) -> None:
-        # caller holds self._lock
+        """Retain ``trace_id`` when ``v`` ranks among the worst recent
+        samples.  Caller holds ``self._lock``."""
         cutoff = mono - EXEMPLAR_MAX_AGE_S
         ex = [e for e in self._ex if e[3] >= cutoff]
         if len(ex) < EXEMPLAR_CAP or v > ex[-1][0]:
@@ -265,7 +271,8 @@ _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
 
 class MetricsRegistry:
-    """Catalog-validated instrument store, keyed (name, label items)."""
+    """Catalog-validated instrument store, keyed (name, label items).
+    Thread-safety: guarded by ``self._lock``."""
 
     def __init__(self, *, window: int = DEFAULT_WINDOW):
         self._lock = threading.Lock()

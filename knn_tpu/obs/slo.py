@@ -290,7 +290,12 @@ def _hist_summary(snapshot: dict, name: str,
 
 class SLOEngine:
     """Evaluates the objectives against the live registry; owns the
-    counter-sample ring the burn-rate windows delta against."""
+    counter-sample ring the burn-rate windows delta against.
+
+    Thread-safety: guarded by ``self._lock`` (one lock over the whole
+    read-evaluate-transition-append pass — see :meth:`evaluate`;
+    machine-checked by the ``locked-mutation`` checker,
+    knn_tpu.analysis)."""
 
     def __init__(self, objectives: Optional[Sequence[Objective]] = None,
                  windows: Sequence[Tuple[str, float]] = DEFAULT_WINDOWS,
@@ -525,7 +530,8 @@ class SLOEngine:
                     extra: Optional[dict] = None) -> None:
         """Edge-triggered breach bookkeeping for one objective (or one
         GROUP of a grouped objective — ``key`` is ``name:value`` then,
-        and ``extra`` carries the group label into the alert event)."""
+        and ``extra`` carries the group label into the alert event).
+        Caller holds ``self._lock`` (evaluate()'s single pass)."""
         was = self._breached.get(key, False)
         is_now = entry["breached"]
         registry.gauge(names.SLO_BREACHED, objective=key).set(

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from knn_tpu import obs
+from knn_tpu.analysis.annotations import hot_path
 from knn_tpu.obs import names as mn
 from knn_tpu.serving.buckets import (
     DEFAULT_MAX_BUCKET,
@@ -165,6 +166,11 @@ class ServingEngine:
     AOT-compile every bucket, or let the first request of each bucket pay
     its compile once.  All compile/dispatch accounting is exposed via
     :meth:`stats`.
+
+    Thread-safety: guarded by ``self._lock`` (machine-checked by the
+    ``locked-mutation`` checker, knn_tpu.analysis); the lock is never
+    held across an XLA compile or a device dispatch (see
+    :meth:`_executable`).
 
     ``donate_queries=None`` donates the query placement to the program on
     non-CPU backends (buffer reuse; CPU XLA rejects the donation with a
@@ -347,6 +353,7 @@ class ServingEngine:
         return counts
 
     # -- dispatch ----------------------------------------------------------
+    @hot_path
     def _dispatch_chunk(self, op: str, chunk: np.ndarray,
                         trace_id: Optional[str] = None):
         """Pad one <=max_bucket chunk to its bucket and dispatch (async).
@@ -375,6 +382,9 @@ class ServingEngine:
         obs.counter(mn.SERVING_DISPATCHES, op=op, bucket=bucket).inc()
         return out, go, n
 
+    # np.asarray/ascontiguousarray coerce the caller's HOST request
+    # array (never a device fetch); int() reads numpy shape tuples
+    @hot_path(allow=("np.asarray", "np.ascontiguousarray", "int"))
     def submit(self, queries, *, op: str = "search",
                trace_id: Optional[str] = None,
                tenant: Optional[str] = None) -> PendingSearch:

@@ -41,6 +41,7 @@ from typing import List, Optional
 import numpy as np
 
 from knn_tpu import obs
+from knn_tpu.analysis.annotations import hot_path
 from knn_tpu.obs import names as mn
 from knn_tpu.serving.admission import (
     AdmissionConfig,
@@ -84,6 +85,11 @@ class QueryQueue:
     past it); ``admission`` is the full policy (quotas, deadline
     shedding, priorities — knn_tpu.serving.admission).  Both default
     off.
+
+    Thread-safety: guarded by ``self._cond`` (a Condition — the same
+    ``with``-protocol the ``locked-mutation`` checker reads; the
+    completer thread's single-writer service-rate state is the one
+    documented exception, carried in the suppression file).
 
     Use as a context manager, or call :meth:`close` (flushes pending
     requests, then joins both threads).
@@ -169,6 +175,9 @@ class QueryQueue:
         obs.health.register_queue(self)
 
     # -- client side -------------------------------------------------------
+    # np.asarray/ascontiguousarray coerce the caller's HOST request
+    # array (never a device fetch); int() reads numpy shape tuples
+    @hot_path(allow=("np.asarray", "np.ascontiguousarray", "int"))
     def submit(self, queries, *, tenant: Optional[str] = None,
                deadline_ms: Optional[float] = None,
                priority: Optional[int] = None) -> Future:
@@ -414,6 +423,8 @@ class QueryQueue:
                 tenant=p.tenant, reason="expired"))
         self._retire(shed)
 
+    # int() reads numpy shape tuples / offset scalars, all host-side
+    @hot_path(allow=("int",))
     def _batcher(self) -> None:
         while True:
             batch, shed = self._take_batch()

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from knn_tpu import obs
+from knn_tpu.analysis import vmem as _vmem
 from knn_tpu.obs import names as _mn
 from knn_tpu.tuning.cache import TuneCache, cache_key, default_cache_path
 
@@ -80,6 +81,7 @@ _COUNTERS = {
     "candidates_timed": 0,   # candidates built+timed (0 on a warm cache)
     "candidates_gated_out": 0,  # candidates rejected by the bitwise gate
     "candidates_pruned": 0,  # skipped before timing by the roofline model
+    "candidates_vmem_refused": 0,  # refused by the VMEM budget gate
 }
 
 
@@ -108,6 +110,7 @@ _OBS_TWIN = {
     "candidates_timed": _mn.TUNING_CANDIDATES_TIMED,
     "candidates_gated_out": _mn.TUNING_GATE_FAILURES,
     "candidates_pruned": _mn.TUNING_CANDIDATES_PRUNED,
+    "candidates_vmem_refused": _mn.TUNING_CANDIDATES_VMEM_REFUSED,
 }
 
 
@@ -223,6 +226,18 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
       (streaming + db_major) are skipped at enumeration, duplicates
       dropped, order deterministic.
 
+    The grid does NOT model-censor on VMEM: every combination that
+    fits at least one known device kind is enumerated and the two
+    explicit gates judge it — the ``vmem-budget`` checker in ``cli
+    lint`` fails loudly at authoring time if a fits-NOWHERE arm is
+    added, and the runtime gate in :func:`autotune` refuses
+    over-budget candidates at the REAL shape/device with provenance.
+    The one authored exclusion below (bf16x3f x streaming/fused x
+    tile_n>=32768 x block_q>=256, ~140 MB/launch — over every known
+    device kind) is itself pinned by that checker; a generic
+    model-driven cut here would hide fitting candidates with no
+    provenance, which is exactly what the gates exist to prevent.
+
     ``final_select`` is part of every level (the exact/approx deviation
     at the otherwise-winning geometries): a cached winner's
     final_select is therefore a MEASURED choice, never a default copied
@@ -246,6 +261,18 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
                 knobs["final_select"] == "approx"
                 or knobs["binning"] != "grouped"):
             return  # the early-out's bitwise contract is exact+grouped
+        if (knobs["precision"] == "bf16x3f"
+                and knobs["kernel"] in ("streaming", "fused")
+                and (knobs["tile_n"] or 0) >= 32768
+                and (knobs["block_q"] or 128) >= 256):
+            # widest streamed db precision (6 B/elem) x largest tile x
+            # block_q>=256: ~140 MB/launch at the headline shape —
+            # over EVERY known device kind's VMEM, so the arm can
+            # never be timed anywhere (knn_tpu.analysis.vmem; the
+            # vmem-budget checker fails the lint if a fits-nowhere arm
+            # like this sneaks back in).  The block_q=128 variants
+            # price at ~96 MB, fit v4+, and stay in the grid.
+            return
         lbl = _label(knobs)
         if lbl not in seen:
             seen.add(lbl)
@@ -501,6 +528,17 @@ def autotune(
     ``errors``) so the decision is auditable: a pruned candidate that
     would have won the bitwise+timing gate with pruning off is a test
     failure, not a silent loss (tests/test_fused_overlap.py).
+
+    **VMEM budget gate** (knn_tpu.analysis.vmem; always on when the
+    device kind has a VMEM budget — cpu/interpret backends disarm it):
+    also before any timing, every candidate's per-launch VMEM footprint
+    is priced against the device kind's capacity; over-budget
+    candidates are REFUSED — they would fail at Mosaic compile time on
+    hardware, mid-tune, the worst place to discover it — with each
+    refusal recorded in ``entry["vmem"]["refused"]`` and mirrored as a
+    ``vmem-refused: ...`` entry in ``errors`` (provenance like roofline
+    pruning; the ``vmem-budget`` checker in ``cli lint`` statically
+    enforces the same model over the grid).
     """
     import jax
 
@@ -572,6 +610,57 @@ def autotune(
             "pruned": pruned_rec,
         }
         candidates = kept
+
+    # VMEM budget gate BEFORE any timing (knn_tpu.analysis.vmem; always
+    # on when the device kind has a budget — cpu/interpret backends have
+    # no VMEM and the gate disarms): a candidate whose estimated
+    # per-launch footprint exceeds this device kind's VMEM would fail at
+    # Mosaic compile time on hardware, mid-tune, so it is refused here
+    # with provenance — recorded like roofline pruning (entry["vmem"] +
+    # a "vmem-refused: ..." errors line), never silently
+    budget_bytes, budget_estimated = _vmem.budget_for(device_kind,
+                                                      backend)
+    vmem_info = None
+    if budget_bytes is not None:
+        refused_rec: Dict[str, dict] = {}
+        kept_v: List[Dict[str, object]] = []
+        for cand in candidates:
+            knobs = dict(DEFAULT_KNOBS)
+            knobs.update(cand)
+            label = _label(knobs)
+            if label in timings:
+                kept_v.append(cand)  # already recorded (pruned/dup)
+                continue
+            try:
+                verdict = _vmem.check_candidate(
+                    knobs, n=n, d=d, k=k, margin=margin,
+                    device_kind=device_kind, backend=backend)
+            except ValueError:
+                kept_v.append(cand)  # unpriceable: never widen-refuse
+                continue
+            if verdict["fits"] is False:
+                timings[label] = None
+                errors[label] = (
+                    f"vmem-refused: estimated "
+                    f"{verdict['estimate_bytes']} bytes/launch > "
+                    f"{verdict['budget_bytes']}-byte VMEM budget of "
+                    f"{device_kind}")
+                refused_rec[label] = {
+                    "estimate_bytes": verdict["estimate_bytes"],
+                    "budget_bytes": verdict["budget_bytes"],
+                }
+            else:
+                kept_v.append(cand)
+        if refused_rec:
+            _bump("candidates_vmem_refused", len(refused_rec))
+        vmem_info = {
+            "device_kind": device_kind,
+            "budget_bytes": budget_bytes,
+            "estimated_budget": budget_estimated,
+            "candidates_refused": len(refused_rec),
+            "refused": refused_rec,
+        }
+        candidates = kept_v
     best_label, best_ms, best_knobs = None, None, None
     for cand in candidates:
         knobs = dict(DEFAULT_KNOBS)
@@ -661,6 +750,8 @@ def autotune(
     }
     if pruning_info is not None:
         entry["pruning"] = pruning_info
+    if vmem_info is not None:
+        entry["vmem"] = vmem_info
     if winner_rl is not None:
         entry["roofline"] = winner_rl
         entry["roofline_pct"] = winner_rl["roofline_pct"]

@@ -42,8 +42,9 @@ from knn_tpu.obs import names as _mn
 class PhaseTimer:
     """Accumulates named phase durations; total covers first start→last stop
     (the reference's single Wtime pair, knn_mpi.cpp:134,396, recovered as
-    the sum).  Thread-safe; re-entrant nesting within a thread raises
-    (see module docstring)."""
+    the sum).  Thread-safety: guarded by ``self._lock`` (machine-checked
+    by the ``locked-mutation`` checker, knn_tpu.analysis); re-entrant
+    nesting within a thread raises (see module docstring)."""
 
     def __init__(self):
         self.phases: Dict[str, float] = {}

@@ -9,20 +9,23 @@
 # int8 bitwise contracts) + quantization bound soundness + the autotuner
 # gate + the telemetry registry/exporters + the SLO engine, perf
 # sentinel, and roofline cost model (docs/OBSERVABILITY.md; the
-# metric-name lint and the sentinel's config/roofline-block lint ride
-# along so an undocumented metric, a broken SLO config, or a malformed
-# roofline block fails here, not in review; the sentinel's
-# check-latest pass prints regression verdicts WARN-ONLY) — for
-# edit-compile-test cycles on kernel/emitter/obs code (~tens of seconds
-# instead of the full suite).  The full gate remains the only gate that
-# counts; --fast is a developer convenience (docs/PERF.md).
+# static-analysis suite `cli lint` (docs/ANALYSIS.md: switch/metric
+# lockstep, locked-mutation, jax-hygiene, VMEM budget) and the
+# sentinel's config/roofline-block lint ride along as HARD gates so an
+# uncataloged switch, an undocumented metric, an unlocked mutation, a
+# broken SLO config, or an over-VMEM knob candidate fails here, not in
+# review; the sentinel's check-latest pass prints regression verdicts
+# WARN-ONLY) — for edit-compile-test cycles on kernel/emitter/obs code
+# (~tens of seconds instead of the full suite).  The full gate remains
+# the only gate that counts; --fast is a developer convenience
+# (docs/PERF.md).
 #
 # --strict: the full gate PLUS the perf sentinel as a HARD gate — any
 # `regress` verdict on the newest curated bench round against its
 # history fails the run (docs/OBSERVABILITY.md "Regression sentinel").
 cd "$(dirname "$0")/.." || exit 1
 if [ "${1:-}" = "--fast" ]; then
-  python scripts/lint_metric_names.py || exit 1
+  python -m knn_tpu.cli lint || exit 1  # the full static-analysis suite
   python scripts/perf_sentinel.py --lint || exit 1
   python scripts/perf_sentinel.py --check-latest || true  # warn-only here
   exec env JAX_PLATFORMS=cpu python -m pytest \
@@ -35,8 +38,9 @@ if [ "${1:-}" = "--fast" ]; then
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "${1:-}" = "--strict" ]; then
-  python scripts/lint_metric_names.py || exit 1
+  # (cli lint runs once, at the unconditional hard gate below)
   python scripts/perf_sentinel.py --lint || exit 1
   python scripts/perf_sentinel.py --check-latest --strict || exit 1
 fi
+python -m knn_tpu.cli lint || exit 1  # hard gate on BOTH paths (docs/ANALYSIS.md)
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

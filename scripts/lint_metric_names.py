@@ -1,100 +1,37 @@
 #!/usr/bin/env python
-"""Metric-name lint — the CI tripwire of the telemetry contract
-(docs/OBSERVABILITY.md).
+"""Metric-name lint — now a thin shim over the ``metric-lockstep``
+checker of the static-analysis framework (knn_tpu.analysis,
+docs/ANALYSIS.md).
 
-Three invariants, each cheap and jax-free (knn_tpu.obs imports no JAX):
+The three invariants this script enforced since the telemetry subsystem
+landed (catalog well-formedness, catalog->docs coverage, no inline
+literals bypassing the catalog) live in
+``knn_tpu/analysis/check_metrics.py`` and run — alongside the other
+checkers — via ``python -m knn_tpu.cli lint`` (the check_tier1 gate).
+This entry point keeps the historical exit-code contract for existing
+wiring and habits: exit 0 = green, nonzero prints every violation.
 
-1. every catalog name (knn_tpu.obs.names.CATALOG — the ONLY names the
-   registry will hand out) matches ``knn_tpu_[a-z0-9_]+``;
-2. every catalog name appears in the docs/OBSERVABILITY.md catalog —
-   an instrumented path can't ship an undocumented metric;
-3. every metric-shaped string literal in the source tree is a catalog
-   name — nobody bypasses the names module with an inline literal
-   (the registry would refuse it at runtime; this catches it at lint
-   time), and the docs don't advertise phantom metrics (every doc
-   mention resolves to a catalog name, modulo the Prometheus summary
-   suffixes ``_sum``/``_count``).
-
-Exit 0 = green; nonzero prints every violation.
+Note: ONLY the metric-lockstep checker runs here (same scope as the
+original script, suppressions applied); the full suite is `cli lint`.
 """
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from knn_tpu.obs.names import CATALOG  # noqa: E402 - path set above
-from knn_tpu.obs.registry import NAME_RE  # noqa: E402
+from knn_tpu import analysis  # noqa: E402 - path set above
+from knn_tpu.obs.names import CATALOG  # noqa: E402
 
-DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
-TOKEN = re.compile(r"\bknn_tpu_[a-z0-9_]+\b")
-#: Prometheus renders histogram series with these suffixes; the doc may
-#: (and does) show them in examples
-SUFFIXES = ("_sum", "_count")
-
-errors = []
-
-# 1. catalog names are well-formed
-for name in CATALOG:
-    if not NAME_RE.match(name):
-        errors.append(f"catalog name {name!r} does not match {NAME_RE.pattern}")
-
-# 2. every catalog name is documented
-try:
-    doc_text = open(DOC).read()
-except OSError as e:
-    errors.append(f"cannot read {DOC}: {e}")
-    doc_text = ""
-doc_tokens = set(TOKEN.findall(doc_text))
-for name in CATALOG:
-    if name not in doc_tokens:
-        errors.append(f"{name} is registrable but missing from "
-                      f"docs/OBSERVABILITY.md")
-
-
-def base(token: str) -> str:
-    for suf in SUFFIXES:
-        if token.endswith(suf) and token[: -len(suf)] in CATALOG:
-            return token[: -len(suf)]
-    return token
-
-
-# 3a. doc tokens resolve to catalog names (no phantom metrics)
-for token in sorted(doc_tokens):
-    if base(token) not in CATALOG:
-        errors.append(f"docs/OBSERVABILITY.md mentions {token}, which is "
-                      f"not a catalog metric")
-
-# 3b. source literals resolve to catalog names (no catalog bypass).
-# tests/ is exempt (negative tests deliberately use bad names); tokens
-# ending in "_" are prefixes (docstring brace shorthand, tempdir
-# prefixes), not metric names — a real metric never ends in underscore.
-SKIP = {os.path.join("knn_tpu", "obs", "names.py")}
-for root in ("knn_tpu", "scripts"):
-    for dirpath, _dirs, files in os.walk(os.path.join(REPO, root)):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, REPO)
-            if rel in SKIP or os.path.abspath(path) == os.path.abspath(
-                    __file__):
-                continue
-            for token in TOKEN.findall(open(path).read()):
-                if token.endswith("_"):
-                    continue
-                if base(token) not in CATALOG:
-                    errors.append(f"{rel}: literal {token} is not a "
-                                  f"catalog metric")
-
-if errors:
-    print(f"lint_metric_names: {len(errors)} violation(s)")
-    for e in errors:
-        print(f"  {e}")
+report = analysis.run(REPO, names=["metric-lockstep"])
+if not report.ok:
+    print(f"lint_metric_names: {len(report.findings)} violation(s)")
+    for f in report.findings:
+        loc = f"{f.path}:{f.line}: " if f.line else (
+            f"{f.path}: " if f.path else "")
+        print(f"  {loc}{f.message}")
     sys.exit(1)
 print(f"lint_metric_names: OK ({len(CATALOG)} cataloged metrics, "
-      f"{len(doc_tokens)} documented tokens)")
+      f"{report.suppressed} suppressed; full suite: "
+      f"python -m knn_tpu.cli lint)")

@@ -14,37 +14,25 @@ import os
 import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Switch isolation is GENERATED from the central env-switch catalog
+# (knn_tpu.analysis.switches — jax-free, so this import is safe before
+# the backend config below): every cataloged mutable switch plus any
+# ambient variable under a cataloged family prefix is scrubbed, so a
+# developer shell's KNN_TPU_*/KNN_BENCH_* can never silently steer the
+# suite.  Never hand-list switches here again — declare them in the
+# catalog and isolation follows on the next run (the switch-lockstep
+# checker fails the lint if this derivation is removed).  Tests that
+# exercise a switch set their own value AFTER this scrub, per-test.
+from knn_tpu.analysis.switches import isolation_names
+
+for _knob in isolation_names(os.environ):
+    os.environ.pop(_knob, None)
 # isolate the autotuner's persisted winner cache: a developer machine's
 # real ~/.cache/knn_tpu/autotune.json must never steer test kernels
-# (tests that exercise the cache pass explicit paths / their own env)
+# (tests that exercise the cache pass explicit paths / their own env).
+# Set AFTER the scrub — this is the suite's own value, not an ambient one.
 os.environ["KNN_TPU_TUNE_CACHE"] = os.path.join(
     tempfile.mkdtemp(prefix="knn_tpu_test_tune_"), "autotune.json")
-# isolate the telemetry env knobs the same way: the suite assumes the
-# default-on registry, no ambient JSONL sink, the default rotation cap,
-# the default SLO objectives, and a DISARMED flight recorder — an
-# ambient KNN_TPU_POSTMORTEM_DIR would write a postmortem bundle on
-# every test that trips an SLO breach (tests that exercise these set
-# their own paths/values explicitly)
-for _knob in ("KNN_TPU_OBS", "KNN_TPU_OBS_LOG",
-              "KNN_TPU_OBS_LOG_MAX_BYTES", "KNN_TPU_SLO_CONFIG",
-              "KNN_TPU_POSTMORTEM_DIR", "KNN_TPU_POSTMORTEM_KEEP",
-              # an ambient prune threshold would silently shrink every
-              # autotune grid in the suite; an ambient overlap switch
-              # would flip every certified search onto the pipelined
-              # path (tests that exercise them set their own values)
-              "KNN_TPU_TUNE_PRUNE", "KNN_TPU_PIPELINE_OVERLAP",
-              "KNN_TPU_PIPELINE_DEPTH"):
-    os.environ.pop(_knob, None)
-# isolate the admission-control and loadgen knobs: a developer shell's
-# ambient KNN_TPU_ADMISSION_* would silently flip every QueryQueue in
-# the suite onto the admission path (AdmissionConfig.from_env treats
-# ANY set knob as an opt-in), breaking the disabled-mode
-# bitwise-identity pins (tests that exercise admission build explicit
-# AdmissionConfig objects or set their own env)
-for _knob in [k for k in os.environ
-              if k.startswith(("KNN_TPU_ADMISSION_", "KNN_TPU_LOADGEN_",
-                               "KNN_BENCH_KNEE_"))]:
-    os.environ.pop(_knob, None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

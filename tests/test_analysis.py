@@ -1,0 +1,906 @@
+"""The static-analysis suite itself (knn_tpu.analysis, docs/ANALYSIS.md):
+framework semantics (registry, suppression grammar, crash-to-finding),
+one known-bad and one known-good fixture per checker, the geometry/width
+mirror pins of the VMEM model, the autotuner's runtime VMEM gate, the
+runtime lock-order (deadlock) harness over the real serving stack, and
+the ``cli lint`` subprocess exit-code contract.
+
+The fixture trees seed deliberate violations (uncataloged switches,
+phantom metrics, unlocked mutations) — tests/ is exempt from the lint's
+source roots precisely so these seeds never trip the real gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from knn_tpu import analysis
+from knn_tpu.analysis import switches as sw
+from knn_tpu.analysis import vmem
+from knn_tpu.analysis.check_vmem import grid_findings
+from knn_tpu.analysis.core import CHECKERS, load_suppressions
+from knn_tpu.analysis.lockorder import (
+    InstrumentedLock,
+    LockOrderRecorder,
+    instrument,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_tree(root, files):
+    for rel, text in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+
+
+def run_on(root, checker):
+    return analysis.run(str(root), names=[checker])
+
+
+# --- framework ----------------------------------------------------------
+def test_registry_has_the_five_checkers():
+    assert set(CHECKERS) == {"switch-lockstep", "metric-lockstep",
+                             "locked-mutation", "jax-hygiene",
+                             "vmem-budget"}
+
+
+def test_unknown_checker_raises():
+    with pytest.raises(ValueError, match="unknown checker"):
+        analysis.run(REPO, names=["no-such-checker"])
+
+
+def test_syntax_error_becomes_finding(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/broken.py": "def f(:\n"})
+    rep = run_on(tmp_path, "locked-mutation")
+    assert not rep.ok
+    assert any(f.checker == "framework" and "does not parse" in f.message
+               for f in rep.findings)
+
+
+def test_text_only_pass_skips_the_parse(tmp_path):
+    """A pass selecting only non-AST checkers (the lint_metric_names
+    shim's metric-lockstep run) keeps the original text lint's
+    tolerance of unparseable files — no whole-tree parse, no
+    syntax-error findings that would be wrong for a pass in which no
+    AST checker ran."""
+    write_tree(tmp_path, {"knn_tpu/broken.py": "def f(:\n"})
+    rep = run_on(tmp_path, "metric-lockstep")
+    assert rep.ok, [f.message for f in rep.findings]
+    rep2 = run_on(tmp_path, "vmem-budget")
+    assert not any(f.checker == "framework" for f in rep2.findings)
+
+
+def test_checker_crash_becomes_finding(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/ok.py": "x = 1\n"})
+
+    def boom(ctx):
+        raise RuntimeError("kaboom")
+
+    CHECKERS["test-boom"] = (boom, "always crashes")
+    try:
+        rep = analysis.run(str(tmp_path), names=["test-boom"])
+    finally:
+        del CHECKERS["test-boom"]
+    assert not rep.ok
+    assert any("checker crashed" in f.message and "kaboom" in f.message
+               for f in rep.findings)
+
+
+def test_report_json_shape(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/ok.py": "x = 1\n"})
+    rep = run_on(tmp_path, "locked-mutation")
+    d = rep.as_dict()
+    assert d["ok"] is True
+    assert d["checkers"] == ["locked-mutation"]
+    assert d["findings"] == [] and d["suppressed"] == 0
+    assert "OK" in rep.render_text()
+
+
+# --- suppression grammar ------------------------------------------------
+def _sup_file(tmp_path, payload):
+    p = tmp_path / "sup.json"
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+def test_suppression_requires_written_justification(tmp_path):
+    path = _sup_file(tmp_path, {"suppressions": [
+        {"checker": "jax-hygiene", "path": "a.py", "contains": "x",
+         "justification": "because"}]})  # < 10 chars
+    sups, errors = load_suppressions(path)
+    assert sups == []
+    assert any("justification" in e.message for e in errors)
+
+
+def test_suppression_rejects_unknown_keys_and_shapes(tmp_path):
+    path = _sup_file(tmp_path, {"suppressions": [
+        {"checker": "jax-hygiene", "line": 3,
+         "justification": "long enough justification"}]})
+    _, errors = load_suppressions(path)
+    assert any("unknown keys" in e.message for e in errors)
+    path2 = _sup_file(tmp_path, {"not-suppressions": []})
+    _, errors2 = load_suppressions(path2)
+    assert any("top level" in e.message for e in errors2)
+
+
+def test_stale_suppression_is_a_finding(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/ok.py": "x = 1\n"})
+    path = _sup_file(tmp_path, {"suppressions": [
+        {"checker": "locked-mutation", "path": "knn_tpu/gone.py",
+         "contains": "self._x",
+         "justification": "outlived the code it excused"}]})
+    rep = analysis.run(str(tmp_path), names=["locked-mutation"],
+                       suppressions_path=path)
+    assert not rep.ok
+    assert any("stale suppression" in f.message for f in rep.findings)
+
+
+def test_subset_run_does_not_condemn_other_checkers_suppressions(
+        tmp_path):
+    """A metric-lockstep-only pass (the lint_metric_names shim) must not
+    flag the jax-hygiene suppressions as stale."""
+    write_tree(tmp_path, {"knn_tpu/ok.py": "x = 1\n"})
+    path = _sup_file(tmp_path, {"suppressions": [
+        {"checker": "jax-hygiene", "path": "knn_tpu/obs/trace.py",
+         "contains": "time.time",
+         "justification": "wall timestamp by contract, never differenced"
+         }]})
+    rep = analysis.run(str(tmp_path), names=["locked-mutation"],
+                       suppressions_path=path)
+    assert rep.ok, [f.message for f in rep.findings]
+    # ...but an entry naming a checker that doesn't exist is stale in
+    # EVERY pass
+    path2 = _sup_file(tmp_path, {"suppressions": [
+        {"checker": "no-such-checker", "path": "", "contains": "x",
+         "justification": "points at nothing that could ever match"}]})
+    rep2 = analysis.run(str(tmp_path), names=["locked-mutation"],
+                        suppressions_path=path2)
+    assert any("stale suppression" in f.message for f in rep2.findings)
+
+
+def test_matching_suppression_silences_and_counts(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/mod.py": '''
+        import time
+
+        def f():
+            return time.time()
+        '''})
+    rep = analysis.run(str(tmp_path), names=["jax-hygiene"])
+    assert not rep.ok and rep.findings[0].symbol == "time.time"
+    path = _sup_file(tmp_path, {"suppressions": [
+        {"checker": "jax-hygiene", "path": "knn_tpu/mod.py",
+         "contains": "time.time",
+         "justification": "fixture wall timestamp, never differenced"}]})
+    rep2 = analysis.run(str(tmp_path), names=["jax-hygiene"],
+                        suppressions_path=path)
+    assert rep2.ok and rep2.suppressed == 1
+
+
+# --- switch-lockstep ----------------------------------------------------
+ALL_SWITCH_NAMES = "\n".join(s.name for s in sw.SWITCHES)
+
+GOOD_SWITCH_TREE = {
+    # a CODE literal (not a docstring): consumption is judged on code
+    "knn_tpu/mod.py": f'_READS = """\n{ALL_SWITCH_NAMES}\n"""\n',
+    "docs/SWITCHES.md": ALL_SWITCH_NAMES + "\n",
+    "tests/conftest.py": """
+        import os
+
+        from knn_tpu.analysis.switches import isolation_names
+
+        for k in isolation_names(os.environ):
+            os.environ.pop(k, None)
+        """,
+}
+
+
+def test_switch_checker_passes_known_good_tree(tmp_path):
+    write_tree(tmp_path, GOOD_SWITCH_TREE)
+    rep = run_on(tmp_path, "switch-lockstep")
+    assert rep.ok, [f.message for f in rep.findings]
+
+
+def test_switch_checker_flags_uncataloged_switch(tmp_path):
+    tree = dict(GOOD_SWITCH_TREE)
+    tree["knn_tpu/rogue.py"] = '''
+        import os
+
+        FLAG = os.environ.get("KNN_TPU_TOTALLY_BOGUS")
+        '''
+    write_tree(tmp_path, tree)
+    rep = run_on(tmp_path, "switch-lockstep")
+    assert not rep.ok
+    hits = [f for f in rep.findings if f.symbol == "KNN_TPU_TOTALLY_BOGUS"]
+    assert hits and "not declared in the switch catalog" in hits[0].message
+    assert hits[0].path == os.path.join("knn_tpu", "rogue.py")
+
+
+def test_switch_checker_flags_phantom_doc_and_missing_doc(tmp_path):
+    tree = dict(GOOD_SWITCH_TREE)
+    tree["docs/SWITCHES.md"] = (
+        ALL_SWITCH_NAMES.replace("KNN_TPU_OBS_LOG\n", "")
+        + "\nKNN_BENCH_PHANTOM_KNOB\n")
+    write_tree(tmp_path, tree)
+    rep = run_on(tmp_path, "switch-lockstep")
+    msgs = [f.message for f in rep.findings]
+    assert any("KNN_TPU_OBS_LOG is missing from the docs" in m
+               for m in msgs)
+    assert any("KNN_BENCH_PHANTOM_KNOB" in m and "phantom" in m
+               for m in msgs)
+
+
+def test_switch_checker_flags_handlisted_conftest(tmp_path):
+    tree = dict(GOOD_SWITCH_TREE)
+    tree["tests/conftest.py"] = '''
+        import os
+
+        os.environ.pop("KNN_TPU_OBS", None)  # hand-listed, not derived
+        '''
+    write_tree(tmp_path, tree)
+    rep = run_on(tmp_path, "switch-lockstep")
+    assert any("isolation_names" in f.message for f in rep.findings)
+
+
+def test_isolation_names_generated_from_catalog():
+    names = sw.isolation_names()
+    # every concrete isolate=True switch, no family prefixes
+    assert "KNN_TPU_OBS" in names and "KNN_BENCH_N" in names
+    assert not any(n.endswith("_") for n in names)
+    # ambient members of an isolated family prefix are swept in
+    env = {"KNN_BENCH_PALLAS_FUTURE_KNOB": "1", "UNRELATED": "x"}
+    names_env = sw.isolation_names(env)
+    assert "KNN_BENCH_PALLAS_FUTURE_KNOB" in names_env
+    assert "UNRELATED" not in names_env
+    assert names_env == sorted(set(names_env))
+
+
+def test_switch_checker_docstring_mention_is_not_consumption(tmp_path):
+    """A switch named ONLY in a docstring reads as never-consumed: a
+    deleted env read whose docstring survives must not keep a phantom
+    catalog row alive."""
+    tree = dict(GOOD_SWITCH_TREE)
+    tree["knn_tpu/mod.py"] = (
+        f'"""Docs mention KNN_TPU_OBS_LOG here."""\n_READS = """\n'
+        + ALL_SWITCH_NAMES.replace("KNN_TPU_OBS_LOG\n", "")
+        + '\n"""\n')
+    write_tree(tmp_path, tree)
+    rep = run_on(tmp_path, "switch-lockstep")
+    assert any(f.symbol == "KNN_TPU_OBS_LOG"
+               and "never read by source" in f.message
+               for f in rep.findings)
+
+
+def test_switch_checker_family_prefix_consumption(tmp_path):
+    """A family's members count as consumed through the family prefix
+    appearing as a code literal (admission.py reads its whole family
+    wholesale) — but the RESERVED root namespaces never consume
+    anything, or the invariant would be vacuous."""
+    members = [s.name for s in sw.SWITCHES
+               if s.name.startswith("KNN_TPU_ADMISSION_")
+               and not s.family]
+    assert members, "catalog lost its admission rows?"
+    kept = "\n".join(n for n in ALL_SWITCH_NAMES.splitlines()
+                     if not n.startswith("KNN_TPU_ADMISSION_"))
+    tree = dict(GOOD_SWITCH_TREE)
+    # members consumed only via the non-reserved family prefix: green
+    tree["knn_tpu/mod.py"] = (
+        f'_READS = """\n{kept}\n"""\n'
+        f'ENV_PREFIX = "KNN_TPU_ADMISSION_"\n')
+    write_tree(tmp_path, tree)
+    rep = run_on(tmp_path, "switch-lockstep")
+    assert rep.ok, [f.message for f in rep.findings]
+    # the reserved KNN_TPU_ root prefix (always in code via the flight
+    # recorder) must NOT stand in for the members
+    tree["knn_tpu/mod.py"] = (
+        f'_READS = """\n{kept}\n"""\n_ROOT = "KNN_TPU_"\n')
+    write_tree(tmp_path, tree)
+    rep2 = run_on(tmp_path, "switch-lockstep")
+    flagged = {f.symbol for f in rep2.findings
+               if "never read by source" in f.message}
+    assert set(members) <= flagged
+
+
+def test_lookup_family_semantics():
+    assert sw.lookup("KNN_TPU_OBS") is not None
+    assert sw.lookup("KNN_TPU_ADMISSION_") is not None  # declared prefix
+    # a concrete member of a family still needs its own catalog row
+    assert sw.lookup("KNN_TPU_ADMISSION_NOPE") is None
+    assert sw.lookup("KNN_TPU_TOTALLY_BOGUS") is None
+
+
+# --- metric-lockstep ----------------------------------------------------
+def test_metric_checker_passes_known_good_tree(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/mod.py": '''
+        NAME = "knn_tpu_serving_requests_total"
+        SUFFIXED = "knn_tpu_serving_requests_total_count"  # prom summary
+        '''})
+    rep = run_on(tmp_path, "metric-lockstep")
+    assert rep.ok, [f.message for f in rep.findings]
+
+
+def test_metric_checker_flags_uncataloged_literal(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/mod.py": '''
+        NAME = "knn_tpu_bogus_metric_total"
+        '''})
+    rep = run_on(tmp_path, "metric-lockstep")
+    assert not rep.ok
+    assert any(f.symbol == "knn_tpu_bogus_metric_total"
+               for f in rep.findings)
+
+
+def test_metric_shim_same_exit_codes():
+    """scripts/lint_metric_names.py is a thin shim over the framework
+    checker: exit 0 on the green tree (the historical contract the
+    check_tier1 wiring relies on)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "lint_metric_names.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+# --- locked-mutation ----------------------------------------------------
+BAD_CLASS = '''
+    import threading
+
+
+    class Box:
+        """A shared box.
+
+        Thread-safety: guarded by ``self._lock``.
+        """
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._items = {}
+
+        def bad(self):
+            self._count = 1
+            self._count += 1
+            self._items["k"] = 2
+
+        def good(self):
+            with self._lock:
+                self._count = 3
+                self._items["k"] = 4
+
+        def helper(self):
+            """Bump the count.  Caller holds ``self._lock``."""
+            self._count += 1
+    '''
+
+
+def test_concurrency_checker_flags_unlocked_writes(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/box.py": BAD_CLASS})
+    rep = run_on(tmp_path, "locked-mutation")
+    assert not rep.ok
+    syms = [f.symbol for f in rep.findings]
+    assert syms.count("Box.bad") == 3  # assign, augassign, subscript
+    # locked writes and Caller-holds helpers are clean
+    assert all(s == "Box.bad" for s in syms)
+
+
+def test_concurrency_checker_passes_locked_class(tmp_path):
+    good = BAD_CLASS.replace('''
+        def bad(self):
+            self._count = 1
+            self._count += 1
+            self._items["k"] = 2
+''', "")
+    write_tree(tmp_path, {"knn_tpu/box.py": good})
+    rep = run_on(tmp_path, "locked-mutation")
+    assert rep.ok, [f.message for f in rep.findings]
+
+
+def test_concurrency_checker_flags_nested_callback_write(tmp_path):
+    """A nested def's body runs when CALLED, not where it is defined:
+    a callback built under the lock (fut.add_done_callback) executes
+    later on another thread with no lock held, so the enclosing
+    ``with self._lock:`` must not cover its writes."""
+    write_tree(tmp_path, {"knn_tpu/cb.py": '''
+        import threading
+
+
+        class Box:
+            """Thread-safety: guarded by ``self._lock``."""
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = 0
+
+            def submit(self, fut):
+                with self._lock:
+                    def _cb(_fut):
+                        self._done += 1
+                    fut.add_done_callback(_cb)
+
+            def locked_nested(self, fut):
+                def _cb(_fut):
+                    with self._lock:
+                        self._done += 1  # takes the lock itself: clean
+                fut.add_done_callback(_cb)
+        '''})
+    rep = run_on(tmp_path, "locked-mutation")
+    assert not rep.ok
+    syms = [f.symbol for f in rep.findings]
+    assert syms == ["Box.submit"]
+
+
+def test_concurrency_checker_flags_other_store_contexts(tmp_path):
+    """`for self._x in ...:` and `with ... as self._x:` rebind shared
+    attributes exactly like assignments and must be flagged outside
+    the lock — and stay clean inside it."""
+    write_tree(tmp_path, {"knn_tpu/stores.py": '''
+        import threading
+
+
+        class Box:
+            """Thread-safety: guarded by ``self._lock``."""
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cursor = 0
+                self._fh = None
+
+            def bad_loop(self, chunks):
+                for self._cursor in chunks:
+                    pass
+
+            def bad_with(self, path):
+                with open(path) as self._fh:
+                    pass
+
+            def good_loop(self, chunks):
+                with self._lock:
+                    for self._cursor in chunks:
+                        pass
+        '''})
+    rep = run_on(tmp_path, "locked-mutation")
+    assert not rep.ok
+    syms = sorted(f.symbol for f in rep.findings)
+    assert syms == ["Box.bad_loop", "Box.bad_with"]
+
+
+def test_concurrency_checker_flags_marker_guarding_nothing(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/empty.py": '''
+        import threading
+
+
+        class Empty:
+            """Thread-safety: guarded by ``self._lock``."""
+
+            def method(self):
+                return 1
+        '''})
+    rep = run_on(tmp_path, "locked-mutation")
+    assert any("guards nothing" in f.message or "no shared attributes"
+               in f.message for f in rep.findings)
+
+
+def test_annotated_runtime_classes_lint_clean():
+    """The five thread-safe classes the suite annotates (registry
+    instruments, QueryQueue, ServingEngine, SLOEngine, PhaseTimer) pass
+    the checker on the real tree — with only the justified single-writer
+    suppression (queue completer's service-rate state)."""
+    rep = analysis.run(REPO, names=["locked-mutation"])
+    assert rep.ok, [f.message for f in rep.findings]
+    assert rep.suppressed == 1
+    text = open(os.path.join(REPO, "knn_tpu", "serving", "queue.py"),
+                encoding="utf-8").read()
+    assert "Thread-safety: guarded by ``self._cond``" in text
+
+
+# --- jax-hygiene --------------------------------------------------------
+def test_jax_checker_flags_wall_clock_in_library_only(tmp_path):
+    write_tree(tmp_path, {
+        "knn_tpu/mod.py": '''
+            import time
+
+            def f():
+                return time.time()
+
+            def g():
+                return time.perf_counter()
+            ''',
+        "scripts/driver.py": '''
+            import time
+
+            STARTED = time.time()  # session drivers are out of scope
+            ''',
+    })
+    rep = run_on(tmp_path, "jax-hygiene")
+    assert len(rep.findings) == 1
+    assert rep.findings[0].path == os.path.join("knn_tpu", "mod.py")
+
+
+def test_jax_checker_hot_path_and_allow(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/hot.py": '''
+        import numpy as np
+
+        from knn_tpu.analysis.annotations import hot_path
+
+
+        @hot_path
+        def dispatch(x):
+            y = np.asarray(x)          # finding: host sync on hot path
+            x.block_until_ready()      # finding
+            return y
+
+
+        @hot_path(allow=("np.asarray",))
+        def coerce(x):
+            return np.asarray(x)       # whitelisted at the annotation
+
+
+        def cold(x):
+            return np.asarray(x)       # unannotated: out of scope
+        '''})
+    rep = run_on(tmp_path, "jax-hygiene")
+    syms = sorted(f.symbol for f in rep.findings)
+    assert syms == ["dispatch", "dispatch"]
+
+
+def test_jax_checker_static_arg_hygiene(tmp_path):
+    write_tree(tmp_path, {"knn_tpu/jit.py": '''
+        from functools import partial
+
+        import jax
+
+
+        @partial(jax.jit, static_argnames=("shape",))
+        def build(x, shape=[8, 8]):
+            return x
+
+
+        def caller(x):
+            return build(x, shape=[16, 16])
+        '''})
+    rep = run_on(tmp_path, "jax-hygiene")
+    msgs = [f.message for f in rep.findings]
+    assert any("unhashable default" in m for m in msgs)
+    assert any("unhashable list" in m for m in msgs)
+
+
+# --- vmem model: mirror pins against the source modules -----------------
+def test_vmem_geometry_mirrors_pallas_kernel():
+    from knn_tpu.ops import pallas_knn as pk
+
+    assert vmem.TILE_N_DEFAULT == pk.TILE_N
+    assert vmem.BLOCK_Q_DEFAULT == pk.BLOCK_Q
+    assert vmem.BIN_W == pk.BIN_W
+    assert vmem.DIM_CHUNK == pk.DIM_CHUNK
+    assert vmem.MAX_CARRY_DEPTH == pk.MAX_CARRY_DEPTH
+
+
+def test_vmem_operand_widths_mirror_roofline():
+    from knn_tpu.obs import roofline
+
+    assert set(vmem.DB_PARTS) == set(roofline.DB_ELEM_BYTES)
+    for prec, (n_parts, chunk_w, elem_b) in vmem.DB_PARTS.items():
+        per_dim = n_parts * chunk_w * elem_b / vmem.DIM_CHUNK
+        assert per_dim == roofline.DB_ELEM_BYTES[prec], prec
+    assert vmem.AUX_ROWS == roofline.AUX_ROWS
+    assert vmem.AUX_ROWS_DEFAULT == roofline.AUX_ROWS_DEFAULT
+
+
+def test_launch_estimate_breakdown_and_monotonicity():
+    shape = dict(vmem.HEADLINE_SHAPE)
+    est = vmem.launch_estimate(**shape)
+    assert est["total_bytes"] == sum(est["breakdown"].values())
+    small = vmem.launch_estimate(**shape, tile_n=8192)["total_bytes"]
+    big = vmem.launch_estimate(**shape, tile_n=32768)["total_bytes"]
+    assert small < big
+    bq = vmem.launch_estimate(**shape, block_q=512)["total_bytes"]
+    assert est["total_bytes"] < bq
+    with pytest.raises(ValueError):
+        vmem.launch_estimate(**shape, precision="float8")
+    with pytest.raises(ValueError):
+        vmem.launch_estimate(**shape, kernel="warp")
+
+
+def test_budget_for_provenance():
+    assert vmem.budget_for("TPU v5e") == (128 * vmem.MIB, False)
+    assert vmem.budget_for("TPU v3") == (16 * vmem.MIB, False)
+    # unknown TPU generations get the modern default, flagged estimated
+    assert vmem.budget_for("TPU v9x") == (vmem.DEFAULT_VMEM_BYTES, True)
+    # no VMEM to budget on host backends: N/A, never a refusal
+    assert vmem.budget_for(None, "cpu") == (None, False)
+    assert vmem.budget_for("cpu") == (None, False)
+
+
+def test_check_candidate_verdicts():
+    shape = dict(vmem.HEADLINE_SHAPE)
+    ok = vmem.check_candidate({}, **shape, device_kind="TPU v5e")
+    assert ok["checked"] and ok["fits"] is True
+    over = vmem.check_candidate({"kernel": "streaming", "block_q": 4096},
+                                **shape, device_kind="TPU v3")
+    assert over["fits"] is False
+    assert over["estimate_bytes"] > over["budget_bytes"]
+    na = vmem.check_candidate({}, **shape, backend="cpu")
+    assert na["checked"] is False and na["fits"] is None
+
+
+def test_default_knobs_fit_target_device():
+    from knn_tpu.tuning.autotune import DEFAULT_KNOBS
+
+    verdict = vmem.check_candidate(
+        DEFAULT_KNOBS, **vmem.HEADLINE_SHAPE,
+        device_kind=vmem.TARGET_DEVICE_KIND)
+    assert verdict["fits"] is True
+
+
+def test_knob_grid_carries_no_unfittable_candidate():
+    """The enumeration bound: every grid candidate fits at least one
+    known device kind's VMEM at the headline shape (the same invariant
+    the vmem-budget checker enforces statically)."""
+    from knn_tpu import tuning
+
+    for level in ("quick", "standard", "full"):
+        for cand in tuning.knob_grid(level):
+            knobs = {**tuning.DEFAULT_KNOBS, **cand}
+            assert vmem.fits_some_kind(knobs, **vmem.HEADLINE_SHAPE), (
+                level, cand)
+
+
+def test_vmem_checker_flags_seeded_over_budget_candidate():
+    """The known-bad fixture: a grid carrying a fits-nowhere candidate
+    must produce a vmem-budget finding (and would flip cli lint red)."""
+    from knn_tpu.tuning.autotune import DEFAULT_KNOBS
+
+    bad = {"kernel": "streaming", "precision": "bf16x3f",
+           "tile_n": 32768}
+    findings = grid_findings([bad], DEFAULT_KNOBS)
+    assert findings and findings[0].checker == "vmem-budget"
+    assert "over EVERY known device kind" in findings[0].message
+    # the clean grid produces none
+    assert grid_findings([{}], DEFAULT_KNOBS) == []
+
+
+def test_vmem_checker_red_when_grid_regresses(tmp_path, monkeypatch):
+    """Seeded regression, checker level: an over-VMEM candidate smuggled
+    into knob_grid flips the vmem-budget checker (hence cli lint)
+    nonzero."""
+    import importlib
+
+    at = importlib.import_module("knn_tpu.tuning.autotune")
+
+    real = at.knob_grid
+
+    def rigged(level="standard"):
+        out = real(level)
+        out.append({**at.DEFAULT_KNOBS, "kernel": "streaming",
+                    "precision": "bf16x3f", "tile_n": 32768})
+        return out
+
+    monkeypatch.setattr(at, "knob_grid", rigged)
+    rep = analysis.run(REPO, names=["vmem-budget"])
+    assert not rep.ok
+    assert any(f.checker == "vmem-budget" for f in rep.findings)
+
+
+def test_vmem_checker_green_on_repo():
+    rep = analysis.run(REPO, names=["vmem-budget"])
+    assert rep.ok, [f.message for f in rep.findings]
+
+
+# --- the autotuner's runtime VMEM gate ----------------------------------
+@pytest.fixture
+def tune_data():
+    rng = np.random.default_rng(7)
+    db = (rng.random((700, 16)) * 64).astype(np.float32)
+    q = (rng.random((8, 16)) * 64).astype(np.float32)
+    return db, q
+
+
+def test_autotune_refuses_over_budget_candidate_before_timing(
+        tune_data, tmp_path):
+    """An over-VMEM candidate is refused with provenance BEFORE the
+    bitwise gate or any timing — it can never win, and the refusal is
+    recorded like roofline pruning."""
+    from knn_tpu import tuning
+
+    db, q = tune_data
+    tuning.reset_counters()
+    entry = tuning.autotune(
+        db, q, 5, margin=4, runs=1,
+        cache_path=str(tmp_path / "t.json"),
+        grid=[{}, {"kernel": "streaming", "block_q": 4096}],
+        device_kind="TPU v2")  # 16 MiB budget: bq4096 cannot fit
+    label = "block_q=4096,kernel=streaming"
+    assert entry["timings_ms"][label] is None
+    assert entry["errors"][label].startswith("vmem-refused:")
+    assert entry["winner"] == "defaults"
+    assert entry["vmem"]["device_kind"] == "TPU v2"
+    assert entry["vmem"]["candidates_refused"] == 1
+    assert label in entry["vmem"]["refused"]
+    refused = entry["vmem"]["refused"][label]
+    assert refused["estimate_bytes"] > refused["budget_bytes"]
+    counters = tuning.counters()
+    assert counters["candidates_vmem_refused"] == 1
+    assert counters["candidates_timed"] == 1  # only the defaults
+
+
+def test_autotune_vmem_gate_disarms_off_tpu(tune_data, tmp_path):
+    """cpu/interpret backends have no VMEM: no refusals, no vmem block
+    — the pre-gate entry shape is unchanged."""
+    from knn_tpu import tuning
+
+    db, q = tune_data
+    tuning.reset_counters()
+    entry = tuning.autotune(
+        db, q, 5, margin=4, runs=1,
+        cache_path=str(tmp_path / "t.json"), grid=[{}])
+    assert "vmem" not in entry
+    assert tuning.counters()["candidates_vmem_refused"] == 0
+
+
+# --- lock-order harness (runtime deadlock detection) --------------------
+def test_lockorder_detects_inversion():
+    rec = LockOrderRecorder()
+    a = InstrumentedLock("A", rec)
+    b = InstrumentedLock("B", rec)
+    t1_done = threading.Event()
+
+    def t1():
+        # A -> B ...
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():
+        # ... and B -> A in another thread: an order inversion.  Run
+        # strictly after t1 so the locks themselves can never deadlock
+        # — the ORDER graph still has the cycle, which is the point:
+        # the harness convicts the interleaving that got lucky.
+        t1_done.wait(5)
+        with b:
+            with a:
+                pass
+
+    ts = [threading.Thread(target=t1), threading.Thread(target=t2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    cyc = rec.find_cycle()
+    assert cyc is not None and cyc[0] == cyc[-1]
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        rec.assert_acyclic()
+
+
+def test_lockorder_consistent_order_is_acyclic():
+    rec = LockOrderRecorder()
+    a = InstrumentedLock("A", rec)
+    b = InstrumentedLock("B", rec)
+
+    def worker():
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert rec.order_graph()["A"] == {"B"}
+    assert rec.find_cycle() is None
+    rec.assert_acyclic()
+
+
+def test_instrument_swaps_lock_attrs():
+    rec = LockOrderRecorder()
+
+    class HasLock:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    class HasNeither:
+        pass
+
+    obj = HasLock()
+    instrument(rec, thing=obj)
+    assert isinstance(obj._lock, InstrumentedLock)
+    with pytest.raises(ValueError, match="neither _lock nor _cond"):
+        instrument(rec, bad=HasNeither())
+
+
+def test_serving_stack_lock_order_acyclic_under_hammer(rng):
+    """The 8-thread hammer over the REAL thread-safe classes (engine,
+    queue, SLO engine, registry, a registry histogram) with every lock
+    instrumented: the recorded acquisition-order graph must be acyclic —
+    a cycle is a deadlock waiting for its interleaving even when this
+    run got lucky."""
+    from knn_tpu import obs
+    from knn_tpu.obs import names as mn
+    from knn_tpu.obs.slo import SLOEngine
+    from knn_tpu.parallel import ShardedKNN, make_mesh
+    from knn_tpu.serving import QueryQueue, ServingEngine
+
+    db = (rng.random((64, 8)) * 32).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(), k=3)
+    engine = ServingEngine(prog, buckets=(8, 16))
+    engine.warmup()
+    slo_engine = SLOEngine()
+    rec = LockOrderRecorder()
+    hist = obs.histogram(mn.SERVING_REQUEST_LATENCY, op="search")
+    with QueryQueue(engine, max_wait_ms=1.0) as queue:
+        instrument(rec, engine=engine, queue=queue, slo=slo_engine,
+                   registry=obs.get_registry(), latency_hist=hist)
+        barrier = threading.Barrier(8)
+        errors = []
+
+        def hammer(i):
+            try:
+                barrier.wait(10)
+                futs = [queue.submit(db[: 1 + (i + j) % 8])
+                        for j in range(4)]
+                queue.stats()
+                slo_engine.evaluate()
+                for f in futs:
+                    f.result(timeout=30)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        ts = [threading.Thread(target=hammer, args=(i,))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert not errors, errors
+    assert rec.edges(), "hammer recorded no lock interleavings"
+    rec.assert_acyclic()
+
+
+# --- cli lint subprocess contract ---------------------------------------
+@pytest.mark.slow
+def test_cli_lint_green_on_repo_json():
+    proc = subprocess.run(
+        [sys.executable, "-m", "knn_tpu.cli", "lint", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert set(payload["checkers"]) == set(CHECKERS)
+    assert payload["suppressed"] >= 1  # justified baseline, never hidden
+
+
+@pytest.mark.slow
+def test_cli_lint_seeded_regression_exits_nonzero(tmp_path):
+    """An uncataloged switch in a lint root flips cli lint to exit 1
+    with the finding in the JSON report."""
+    write_tree(tmp_path, {"knn_tpu/rogue.py": '''
+        import os
+
+        FLAG = os.environ.get("KNN_TPU_TOTALLY_BOGUS")
+        '''})
+    proc = subprocess.run(
+        [sys.executable, "-m", "knn_tpu.cli", "lint", "--json",
+         "--root", str(tmp_path), "--checker", "switch-lockstep"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert any(f["symbol"] == "KNN_TPU_TOTALLY_BOGUS"
+               for f in payload["findings"])
+
+
+def test_full_suite_green_in_process():
+    """The in-process twin of the subprocess gate: every checker over
+    the real tree, zero findings, every suppression used and
+    justified."""
+    rep = analysis.run(REPO)
+    assert rep.ok, rep.render_text()
+    assert rep.suppressed >= 1
