@@ -355,7 +355,7 @@ def _init_backend():
     import threading
 
     if "jax" in sys.modules:
-        # in-process callers (scripts/tpu_session.py) arrive with the
+        # in-process callers (scripts/archive/tpu_session.py) arrive with the
         # backend already initialized and HOLDING the device claim — a
         # subprocess probe would deadlock against our own claim, so
         # short-circuit when a backend is already live
@@ -1038,6 +1038,15 @@ def main() -> None:
             "d2h_mb": round(mb, 2),
             "d2h_mbps": round(mb / xfer, 1) if xfer > 0 else None,
             "host_rank_correct_s": round(host, 4),
+            # structured transport provenance (the machine-usable form
+            # of the prose note below): h2d/d2h ride the dev harness's
+            # relay, NOT TPU PCIe, and no latency correction is
+            # applied — so the calibration reconciler
+            # (knn_tpu.obs.traceread.sample_from_phases) excludes the
+            # transfer phases from device-term residuals by reading
+            # this field instead of string-matching the note
+            "transport": {"kind": "dev_relay",
+                          "latency_corrected": False},
             "note": ("sweep wall ~= h2d + device + d2h + rank_correct + "
                      "repair; h2d/d2h ride the dev harness's relay "
                      "(~65 ms latency per call + ~19-38 MB/s), not TPU "
@@ -1099,7 +1108,7 @@ def main() -> None:
 
     def soundness_gate():
         """Small-scale compiled certified search vs the float64 oracle —
-        the same check scripts/tpu_session.py runs, embedded so a bare
+        the same check scripts/archive/tpu_session.py runs, embedded so a bare
         ``python bench.py`` artifact carries its own soundness verdict.
         ~20 s once per run at 128-dim configs, scaling ~linearly with
         dim (the host float64 oracle dominates); KNN_BENCH_GATE=0
@@ -1354,6 +1363,14 @@ def main() -> None:
             rl_fields["bound_class"] = rl_top["bound_class"]
         if rl_top.get("estimated"):
             rl_fields["roofline_estimated"] = True
+        # calibration drift, hoisted when a measured-term overlay
+        # applied (knn_tpu.obs.calibrate): the sentinel's
+        # model_residual_pct baseline flags a model that starts
+        # mispredicting the machine again
+        cal = rl_top.get("calibration")
+        if isinstance(cal, dict) and cal.get("applied") and \
+                isinstance(cal.get("model_residual_pct"), (int, float)):
+            rl_fields["model_residual_pct"] = cal["model_residual_pct"]
     # quantization provenance: precision rides top-level on EVERY line so
     # int8 A/B lines are self-describing and the artifact refresher can
     # curate them separately from the f32-family line of the same config;

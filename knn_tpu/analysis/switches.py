@@ -91,6 +91,24 @@ SWITCHES: Tuple[Switch, ...] = (
        "edge-triggered SLO breach."),
     _s("KNN_TPU_POSTMORTEM_KEEP", "int", "knn_tpu/obs/blackbox.py", _OBS,
        "Postmortem bundle retention cap (default 8)."),
+    # --- measured-term calibration (knn_tpu.obs.calibrate) -------------
+    _s("KNN_TPU_CALIBRATION", "path", "knn_tpu/obs/calibrate.py", _OBS,
+       "Calibration store JSON: per-term roofline scale factors "
+       "reconciled from measured device time (atomic writes, "
+       "model-version-token keys); unset = analytic model only."),
+    # --- measured-ceiling campaign (knn_tpu.campaign) ------------------
+    _s("KNN_TPU_CAMPAIGN_", "family", "knn_tpu/campaign.py", _PERF,
+       "Measured-ceiling campaign knob family (cli campaign); "
+       "namespace scrubbed by conftest.", family=True, reserved=True),
+    _s("KNN_TPU_CAMPAIGN_DIR", "path", "knn_tpu/campaign.py", _PERF,
+       "Campaign artifact directory (one validated JSONL per arm; "
+       "default artifacts/campaign)."),
+    _s("KNN_TPU_CAMPAIGN_ARMS", "spec", "knn_tpu/campaign.py", _PERF,
+       "Comma list of campaign arms to run (bf16x3_tiled, "
+       "bf16x3_streaming, int8_streaming, int8_fused)."),
+    _s("KNN_TPU_CAMPAIGN_ROUND", "int", "knn_tpu/campaign.py", _PERF,
+       "Measurement-round stamp carried into campaign artifact "
+       "provenance."),
     # --- tuning (knn_tpu.tuning) ---------------------------------------
     _s("KNN_TPU_TUNE_CACHE", "path", "knn_tpu/tuning/cache.py", _PERF,
        "Autotuner winner-cache file (default "

@@ -20,7 +20,7 @@ problem shape on whatever backend JAX exposes and persists the winning
 knob set to the on-disk cache, where every subsequent
 ``search_certified``/bench run on the same device kind resolves it with
 zero re-timing — the reproducible replacement for the per-session hand
-search of scripts/tpu_session_r5b.py.
+search of scripts/archive/tpu_session_r5b.py.
 
     python -m knn_tpu.cli metrics --port 9100
     python -m knn_tpu.cli metrics --snapshot /path/run_metrics.json --format prom
@@ -65,6 +65,21 @@ bundle (``KNN_TPU_POSTMORTEM_DIR``), a JSONL event log (the rotated
 ``.1`` generation is merged automatically), or a running process's
 ``/waterfallz`` endpoint.  Jax-free by construction
 (docs/OBSERVABILITY.md "Waterfalls & exemplars").
+
+    python -m knn_tpu.cli campaign --rehearse
+    python -m knn_tpu.cli campaign --round 6 --arms int8_fused,int8_streaming
+
+runs the measured-ceiling campaign (knn_tpu.campaign — ROADMAP open
+item 1 as a push-button loop): per arm, flip the on-hardware gates,
+autotune with roofline+VMEM pruning live, bench with device-trace
+capture, parse the trace (knn_tpu.obs.traceread), reconcile measured
+device time against the roofline model's terms, persist per-term
+calibration factors (knn_tpu.obs.calibrate, `KNN_TPU_CALIBRATION`),
+and write one validated campaign JSONL artifact per arm.
+``--rehearse`` runs the identical loop on CPU against host-phase
+timings and the checked-in trace fixture — the tier-1-testable proof
+of the full capture→parse→reconcile→calibrate→curate pipeline
+(docs/PERF.md "Calibration & measured ceilings").
 
     python -m knn_tpu.cli lint [--json] [--checker NAME]
 
@@ -872,6 +887,103 @@ def run_loadgen(args: argparse.Namespace) -> int:
     return 0
 
 
+def build_campaign_parser() -> argparse.ArgumentParser:
+    from knn_tpu.campaign import ARM_KNOBS, DEFAULT_ARMS
+
+    p = argparse.ArgumentParser(
+        prog="knn_tpu campaign",
+        description="Run the measured-ceiling campaign "
+        "(knn_tpu.campaign): per arm — gates, autotune (roofline+VMEM "
+        "pruning live), bench with trace capture, trace parse, "
+        "reconcile against the roofline terms, persist calibration "
+        "factors, curate one validated JSONL artifact.  --rehearse "
+        "runs the identical loop on CPU (host-phase timings + the "
+        "checked-in trace fixture) without a TPU.",
+    )
+    p.add_argument("--rehearse", action="store_true",
+                   help="CPU rehearsal: tiny synthetic shapes, "
+                   "host-phase timings, fixture trace parse — the "
+                   "tier-1-testable full loop")
+    p.add_argument("--arms", default=None, metavar="A1,A2,...",
+                   help=f"arms to run (default: "
+                   f"{','.join(DEFAULT_ARMS)} on hardware, the "
+                   f"cheapest arm in rehearsal); known: "
+                   f"{', '.join(sorted(ARM_KNOBS))}")
+    p.add_argument("--round", type=int, default=None, dest="round_no",
+                   help="measurement-round stamp for artifact "
+                   "provenance ($KNN_TPU_CAMPAIGN_ROUND equivalent)")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="artifact directory (default: "
+                   "$KNN_TPU_CAMPAIGN_DIR or artifacts/campaign)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="synthetic-data seed (rehearse)")
+    p.add_argument("--grid", default="quick",
+                   choices=("quick", "standard", "full"),
+                   help="autotuner grid level (hardware arms)")
+    p.add_argument("--trace-fixture", default=None, metavar="PATH",
+                   help="trace-viewer artifact the rehearse capture "
+                   "stage parses (default: the checked-in "
+                   "tests/fixtures/minimal.trace.json.gz)")
+    p.add_argument("--calibration", default=None, metavar="PATH",
+                   help="calibration store file "
+                   "($KNN_TPU_CALIBRATION equivalent; default: "
+                   "<out>/calibration.json)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw summary JSON only")
+    p.add_argument("--verbose", action="store_true",
+                   help="stage progress on stderr")
+    p.add_argument("--cpu-devices", type=int, default=None,
+                   metavar="N",
+                   help="force an N-virtual-device CPU backend")
+    return p
+
+
+def run_campaign_cmd(args: argparse.Namespace) -> int:
+    """The `campaign` subcommand: the stage loop per arm, a
+    human-readable per-arm summary, and ONE trailing JSON line (the
+    campaign summary — artifact paths + per-arm outcomes).  Exit 0
+    when every arm completed green, 1 otherwise."""
+    import json
+    import os
+
+    from knn_tpu import campaign
+
+    if args.calibration:
+        os.environ["KNN_TPU_CALIBRATION"] = args.calibration
+    arms = ([a.strip() for a in args.arms.split(",") if a.strip()]
+            if args.arms else None)
+    try:
+        summary = campaign.run_campaign(
+            rehearse=args.rehearse, arms=arms, out_dir=args.out,
+            round_no=args.round_no, seed=args.seed,
+            trace_fixture=args.trace_fixture, grid_level=args.grid,
+            verbose=args.verbose)
+    except ValueError as e:  # unknown arm / bad env spec
+        print(f"campaign: {e}", file=sys.stderr)
+        return 2
+    compact = {k: summary[k] for k in (
+        "campaign_version", "rehearse", "round", "out_dir", "arms",
+        "ok")}
+    if args.json:
+        print(json.dumps(compact, indent=1, sort_keys=True))
+        return 0 if summary["ok"] else 1
+    for r in summary["results"]:
+        line = r.get("line") or {}
+        att = line.get("roofline") or {}
+        cal = att.get("calibration") or {}
+        print(f"arm {r['arm']}: {'OK' if r['ok'] else 'FAILED'}  "
+              f"measured={line.get('device_phase_qps')} q/s  "
+              f"ceiling={att.get('ceiling_qps')} "
+              f"(analytic {att.get('ceiling_qps_analytic')})  "
+              f"calibrated={cal.get('applied')}  "
+              f"model_residual={line.get('model_residual_pct')}%  "
+              f"-> {r.get('artifact')}")
+        for err in r.get("errors") or []:
+            print(f"  error: {err}", file=sys.stderr)
+    print(json.dumps(compact))
+    return 0 if summary["ok"] else 1
+
+
 def build_lint_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="knn_tpu lint",
@@ -980,6 +1092,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_roofline(build_roofline_parser().parse_args(argv[1:]))
     if argv[:1] == ["waterfall"]:
         return run_waterfall(build_waterfall_parser().parse_args(argv[1:]))
+    if argv[:1] == ["campaign"]:
+        cargs = build_campaign_parser().parse_args(argv[1:])
+        if cargs.cpu_devices:
+            from knn_tpu.utils.compat import request_cpu_devices
+
+            request_cpu_devices(cargs.cpu_devices)
+        return run_campaign_cmd(cargs)
     if argv[:1] == ["loadgen"]:
         largs = build_loadgen_parser().parse_args(argv[1:])
         if largs.cpu_devices:

@@ -155,6 +155,13 @@ def _write_bundle(objective: str, detail: dict,
                 if k.startswith(("KNN_TPU_", "KNN_BENCH_",
                                  "JAX_PLATFORMS"))},
     }
+    # measured-term calibration state: the statusz report already
+    # carries the section (health's failure-proof probe) — hoist it
+    # top-level so postmortem readers judging "device bound vs model
+    # wrong" find it beside device_vs_roofline, without a second
+    # store read
+    payload["calibration"] = (payload["statusz"] or {}).get(
+        "calibration")
     with _seq_lock:
         _seq += 1
         seq = _seq

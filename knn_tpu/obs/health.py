@@ -236,6 +236,18 @@ def _postmortems() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _calibration_status() -> dict:
+    """The measured-term calibration store's state (worst per-term
+    residual included) — never fatal: a broken store must not take the
+    status probe down with it."""
+    try:
+        from knn_tpu.obs import calibrate
+
+        return calibrate.status()
+    except Exception as e:  # noqa: BLE001 - introspection must not raise
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def report(slo_section: Optional[dict] = None,
            slowest: Optional[list] = None) -> dict:
     """The full /statusz payload (see module docstring).  Everything in
@@ -268,6 +280,10 @@ def report(slo_section: Optional[dict] = None,
         # (autotuner winners, warm-cache resolves): the named gap per
         # config, rendered by /statusz and doctor
         "roofline": roofline.last_reports(),
+        # the measured-term calibration store: whether this process's
+        # roofline verdicts are calibrated, and the worst per-term
+        # residual on file (knn_tpu.obs.calibrate)
+        "calibration": _calibration_status(),
         "slo": slo_section,
         "active_breaches": (slo_section.get("breached", [])
                             if slo_section else []),
@@ -304,7 +320,7 @@ def report_from_snapshot(payload: dict) -> dict:
         "devices": {"available": False,
                     "reason": "not recorded in this snapshot"},
         "engines": [], "queues": [],
-        "tune_cache": {}, "roofline": {}, "slo": {},
+        "tune_cache": {}, "roofline": {}, "calibration": {}, "slo": {},
         "active_breaches": [], "alerts": [],
         "slowest_requests": [], "postmortems": {},
     }
@@ -357,9 +373,31 @@ def render_text(rep: dict) -> str:
         pct = r.get("roofline_pct")
         pct_s = f"{pct * 100:.1f}% of " if pct is not None else ""
         est = " [estimated peaks]" if r.get("estimated") else ""
+        cal_s = (" [calibrated]" if r.get("calibration_applied")
+                 else "")
         lines.append(f"roofline {cfg}: {pct_s}"
                      f"{r.get('ceiling_qps')} q/s ceiling "
-                     f"({r.get('bound_class')}){est}")
+                     f"({r.get('bound_class')}){est}{cal_s}")
+    cal = rep.get("calibration") or {}
+    if cal.get("store"):
+        worst = cal.get("worst_residual_pct")
+        worst_s = (f", worst term residual {worst}% "
+                   f"({cal.get('worst_residual_key')})"
+                   if worst is not None else "")
+        lines.append(f"calibration: {cal.get('entries')} entr"
+                     f"{'y' if cal.get('entries') == 1 else 'ies'} at "
+                     f"{cal['store']} [{cal.get('model_token')}]"
+                     f"{worst_s}")
+    elif cal.get("error"):
+        # a store that CANNOT report is not the same as no store: the
+        # operator set KNN_TPU_CALIBRATION and deserves the failure,
+        # not a claim that it is unset
+        lines.append(f"calibration: status unavailable "
+                     f"({cal['error']})")
+    elif cal:
+        lines.append("calibration: no store configured "
+                     "(KNN_TPU_CALIBRATION unset) — roofline verdicts "
+                     "are analytic only")
     breaches = rep.get("active_breaches", [])
     lines.append(f"slo breaches: {', '.join(breaches) if breaches else 'none'}")
     def _slo_line(name, o, indent="  "):

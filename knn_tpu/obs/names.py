@@ -95,6 +95,15 @@ ROOFLINE_CEILING_QPS = "knn_tpu_roofline_ceiling_qps"
 ROOFLINE_BOUND = "knn_tpu_roofline_bound"
 ROOFLINE_EVALUATIONS = "knn_tpu_roofline_evaluations_total"
 
+# --- measured-term calibration (knn_tpu.obs.calibrate) -----------------
+CALIBRATION_APPLIED = "knn_tpu_calibration_applied"
+CALIBRATION_AGE = "knn_tpu_calibration_age_seconds"
+CALIBRATION_RESIDUAL = "knn_tpu_calibration_residual_pct"
+
+# --- measured-ceiling campaign (knn_tpu.campaign) ----------------------
+CAMPAIGN_ARMS = "knn_tpu_campaign_arms_total"
+CAMPAIGN_STAGES = "knn_tpu_campaign_stages_total"
+
 #: name -> (type, label names, help).  Types: "counter" (monotone,
 #: float-valued so second-counters work), "gauge", "histogram" (bounded
 #: sample window + lifetime count/sum; exported as a Prometheus summary).
@@ -290,4 +299,27 @@ CATALOG = {
         "counter", (),
         "Roofline attributions published to the registry (autotuner "
         "winners, warm-cache resolves, bench runs)."),
+    CALIBRATION_APPLIED: (
+        "gauge", ("config",),
+        "1 when the labeled config's published roofline block carried "
+        "an APPLIED measured-term calibration overlay "
+        "(knn_tpu.obs.calibrate), 0 when it rendered analytic-only."),
+    CALIBRATION_AGE: (
+        "gauge", ("config",),
+        "Age (seconds) of the calibration entry applied to the "
+        "labeled config — how stale the measured factors are."),
+    CALIBRATION_RESIDUAL: (
+        "gauge", ("config",),
+        "Signed percent by which the ANALYTIC model mispredicted the "
+        "measured device time for the labeled config (the reconciled "
+        "model_residual_pct) — the calibration-drift signal the "
+        "sentinel baselines."),
+    CAMPAIGN_ARMS: (
+        "counter", ("status",),
+        "Measured-ceiling campaign arms completed (cli campaign), by "
+        "terminal status (ok / error)."),
+    CAMPAIGN_STAGES: (
+        "counter", ("stage",),
+        "Campaign pipeline stages executed (gates / tune / bench / "
+        "capture / reconcile / calibrate / curate), across arms."),
 }

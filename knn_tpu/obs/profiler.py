@@ -27,8 +27,9 @@ from __future__ import annotations
 import contextlib
 import os
 import re
+import threading
 import time
-from typing import Iterator, Optional
+from typing import Dict, Iterator, Optional
 
 from knn_tpu.obs import registry, trace
 
@@ -37,6 +38,30 @@ from knn_tpu.obs import registry, trace
 PROFILE_ENV = "KNN_TPU_PROFILE_DIR"
 
 _SECTION_RE = re.compile(r"[^A-Za-z0-9._-]+")
+
+_cap_lock = threading.Lock()
+#: sanitized section -> last capture directory in this process.
+#: Introspection only (doctor/tests ask "what did this process
+#: capture, where?"); the reconciler matches events to configs by the
+#: on-disk convention (traceread.read_section resolves
+#: ``<dir>/<sanitized section>``), never through this map.  Bounded:
+#: sections are config shapes, finite in practice.
+_CAPTURES: Dict[str, str] = {}
+_CAPTURES_MAX = 64
+
+
+def captures() -> Dict[str, str]:
+    """Every section captured in this process and its trace directory
+    (newest last).  Process-local introspection; event→config matching
+    itself rides the capture-directory convention traceread reads."""
+    with _cap_lock:
+        return dict(_CAPTURES)
+
+
+def reset_captures() -> None:
+    """Drop the capture registry (test isolation)."""
+    with _cap_lock:
+        _CAPTURES.clear()
 
 
 def profile_dir() -> Optional[str]:
@@ -70,6 +95,11 @@ def device_trace(section: str,
     t0 = time.perf_counter()
     with jax.profiler.trace(path):
         yield path
+    with _cap_lock:
+        _CAPTURES.pop(sanitize_section(section), None)
+        _CAPTURES[sanitize_section(section)] = path
+        while len(_CAPTURES) > _CAPTURES_MAX:
+            _CAPTURES.pop(next(iter(_CAPTURES)))
     trace.emit_event("profiler.trace", section=sanitize_section(section),
                      trace_dir=path,
                      dur_s=round(time.perf_counter() - t0, 4))

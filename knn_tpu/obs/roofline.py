@@ -46,8 +46,17 @@ a low pct names implementation slack.  Everything here is pure arithmetic on pla
 numbers: the bench, the artifact refresher, the sentinel lint, and the
 ``cli roofline`` subcommand all run it without importing JAX.
 
-Derivation, peak-table provenance, and how to read ``bound_class``:
-docs/PERF.md "Roofline model".
+MODEL_VERSION 3 closes the analytic/measured gap: every block consults
+the calibration overlay (:mod:`knn_tpu.obs.calibrate`, fed by the
+device-trace / host-phase reconciler over :mod:`knn_tpu.obs.traceread`)
+— an applied calibration re-times the terms by their measured scale
+factors and splits ``ceiling_qps`` (measured) from
+``ceiling_qps_analytic``; absent one, the block says
+``calibration: {applied: false}`` explicitly.
+
+Derivation, peak-table provenance, how to read ``bound_class``, and
+the calibration/campaign runbook: docs/PERF.md "Roofline model" and
+"Calibration & measured ceilings".
 """
 
 from __future__ import annotations
@@ -68,7 +77,17 @@ from knn_tpu.obs import names, registry, trace
 #: (``max(t_hbm, t_mxu, t_vpu)``) — so the fused int8/streaming arm's
 #: modeled ceiling rises above the non-fused one, which is exactly the
 #: gap the in-kernel fused select exists to close.
-MODEL_VERSION = 2
+#: 3 = the CALIBRATED model: every block consults the measured-term
+#: calibration overlay (knn_tpu.obs.calibrate, ``KNN_TPU_CALIBRATION``)
+#: and gains an explicit ``calibration`` verdict — when a reconciled
+#: device measurement covers the block's shape key, the per-term scale
+#: factors re-time the terms and ``ceiling_qps`` becomes the MEASURED
+#: ceiling beside the untouched ``ceiling_qps_analytic``; when none
+#: does, ``calibration: {applied: false}`` says so explicitly (a line
+#: can never silently claim calibrated).  The ``estimated`` flag keeps
+#: its PR-6 semantics either way: it names the PEAK TABLE's provenance,
+#: not the overlay's.
+MODEL_VERSION = 3
 
 #: the three resources a config can exhaust, in tie-break order
 BOUND_CLASSES = ("hbm_bound", "mxu_bound", "vpu_select_bound")
@@ -236,6 +255,13 @@ def db_operand_nbytes(n: int, d: int, precision: str) -> Dict[str, int]:
     }
 
 
+def _combined(times: Dict[str, float], select_overlapped: bool) -> float:
+    if select_overlapped:
+        return max(times.values())
+    return max(times["hbm_bound"], times["mxu_bound"]) + \
+        times["vpu_select_bound"]
+
+
 def _terms_to_verdict(model: dict, nq: int,
                       select_overlapped: bool = False) -> None:
     """Fill ceiling_qps + bound_class from the per-term times.  The
@@ -244,7 +270,15 @@ def _terms_to_verdict(model: dict, nq: int,
     overlaps the stream: non-fused kernels and the XLA selectors run
     the select AFTER the streamed scores exist —
     ``max(t_hbm, t_mxu) + t_vpu`` — while the fused kernel's in-loop
-    select rides the HBM stream's shadow, ``max`` of all three."""
+    select rides the HBM stream's shadow, ``max`` of all three.
+
+    MODEL_VERSION 3: the verdict then consults the calibration overlay
+    (:mod:`knn_tpu.obs.calibrate`) — an applied calibration re-times
+    every term by its measured scale factor, making ``ceiling_qps``
+    the MEASURED ceiling (``ceiling_qps_analytic`` keeps the
+    spec-sheet one), and ``bound_class`` names the binding term of the
+    CALIBRATED machine.  With no overlay the analytic numbers stand,
+    under an explicit ``calibration: {applied: false}``."""
     terms = model["terms"]
     times = {
         "hbm_bound": terms["hbm"]["time_s"],
@@ -252,15 +286,73 @@ def _terms_to_verdict(model: dict, nq: int,
         "vpu_select_bound": terms["vpu_select"]["time_s"],
     }
     bound = max(BOUND_CLASSES, key=lambda c: (times[c], -BOUND_CLASSES.index(c)))
-    if select_overlapped:
-        t = max(times.values())
-    else:
-        t = max(times["hbm_bound"], times["mxu_bound"]) + \
-            times["vpu_select_bound"]
+    t = _combined(times, select_overlapped)
     model["bound_class"] = bound
     model["select_overlapped"] = bool(select_overlapped)
     model["ceiling_qps"] = round(nq / t, 1) if t > 0 else None
+    model["ceiling_qps_analytic"] = model["ceiling_qps"]
     model["term_times_s"] = {k: round(v, 6) for k, v in times.items()}
+    _consult_calibration(model, nq, times, select_overlapped)
+
+
+def _consult_calibration(model: dict, nq: int,
+                         times: Dict[str, float],
+                         select_overlapped: bool) -> None:
+    """Overlay the persisted measured-term factors onto this block, if
+    the calibration store covers its shape key.  Failure-proof: a
+    broken store degrades to the analytic verdict with the reason on
+    the block — the model must render even when the overlay cannot."""
+    from knn_tpu.obs import calibrate
+
+    try:
+        entry = calibrate.lookup_for_block(model)
+    except Exception as e:  # noqa: BLE001 — overlay must not kill the model
+        model["calibration"] = {
+            "applied": False,
+            "error": f"{type(e).__name__}: {e}"}
+        return
+    if entry is None:
+        model["calibration"] = {"applied": False}
+        return
+    # a factor is a fit AGAINST one combined-time formula; the kernel
+    # axis in the store key should make this unreachable, but a
+    # hand-edited store must degrade to analytic, never mis-apply
+    if "select_overlapped" in entry and \
+            bool(entry["select_overlapped"]) != bool(select_overlapped):
+        model["calibration"] = {
+            "applied": False,
+            "error": "entry fit under the other select-overlap formula"}
+        return
+    factors = entry.get("factors") or {}
+    cal_times = {
+        "hbm_bound": times["hbm_bound"] * float(factors.get("hbm", 1.0)),
+        "mxu_bound": times["mxu_bound"] * float(factors.get("mxu", 1.0)),
+        "vpu_select_bound": times["vpu_select_bound"]
+        * float(factors.get("vpu_select", 1.0)),
+    }
+    t = _combined(cal_times, select_overlapped)
+    if t <= 0:
+        model["calibration"] = {"applied": False,
+                                "error": "non-positive calibrated time"}
+        return
+    model["ceiling_qps"] = round(nq / t, 1)
+    model["bound_class"] = max(
+        BOUND_CLASSES,
+        key=lambda c: (cal_times[c], -BOUND_CLASSES.index(c)))
+    model["term_times_calibrated_s"] = {
+        k: round(v, 6) for k, v in cal_times.items()}
+    model["calibration"] = {
+        "applied": True,
+        "factors": dict(factors),
+        "method": entry.get("method"),
+        "source": entry.get("source"),
+        "age_s": calibrate.entry_age_s(entry),
+        "samples": entry.get("samples"),
+        "model_residual_pct": entry.get("model_residual_pct"),
+        "term_residual_pct": entry.get("term_residual_pct"),
+        "measured_at": entry.get("measured_at"),
+        "provenance": entry.get("provenance"),
+    }
 
 
 def pallas_cost_model(
@@ -534,6 +626,15 @@ def validate_block(block) -> list:
                     not isinstance(t.get("time_s"), (int, float)) or \
                     t["time_s"] < 0:
                 errors.append(f"terms.{term}.time_s missing or negative")
+    # MODEL_VERSION 3 blocks carry an explicit calibration verdict;
+    # pre-calibration history blocks (v1/v2) legitimately lack it, but
+    # one that IS present must be well-formed — a malformed overlay
+    # claim would poison the model_residual_pct baselines silently
+    if "calibration" in block:
+        from knn_tpu.obs import calibrate
+
+        errors.extend(calibrate.validate_calibration(
+            block["calibration"]))
     return errors
 
 
@@ -567,13 +668,21 @@ def publish(label: str, block: dict) -> None:
                 names.ROOFLINE_BOUND, config=label,
                 **{"class": cls}).set(1.0 if cls == bound else 0.0)
     registry.counter(names.ROOFLINE_EVALUATIONS).inc()
+    cal = block.get("calibration")
+    if isinstance(cal, dict):
+        from knn_tpu.obs import calibrate
+
+        calibrate.publish(label, cal)
     compact = {
         "roofline_pct": pct,
         "ceiling_qps": block.get("ceiling_qps"),
+        "ceiling_qps_analytic": block.get("ceiling_qps_analytic"),
         "bound_class": bound,
         "measured_qps": block.get("measured_qps"),
         "estimated": bool(block.get("estimated")),
         "model_version": block.get("model_version"),
+        "calibration_applied": bool(
+            cal.get("applied")) if isinstance(cal, dict) else False,
     }
     with _lock:
         _LAST.pop(label, None)
@@ -700,8 +809,20 @@ def render_text(block: dict) -> str:
         f"{vp.get('rate_ops', 0) / 1e12:.1f} Tops/s)")
     overlap = (" select overlapped" if block.get("select_overlapped")
                else "")
-    lines.append(f"ceiling: {block.get('ceiling_qps')} q/s "
-                 f"({block.get('bound_class')}{overlap})")
+    cal = block.get("calibration")
+    if isinstance(cal, dict) and cal.get("applied"):
+        lines.append(
+            f"ceiling: {block.get('ceiling_qps')} q/s CALIBRATED "
+            f"({block.get('bound_class')}{overlap}; analytic "
+            f"{block.get('ceiling_qps_analytic')} q/s, model off by "
+            f"{cal.get('model_residual_pct')}%, source "
+            f"{cal.get('source')}, age {cal.get('age_s')}s)")
+    else:
+        err = (f", overlay error: {cal['error']}"
+               if isinstance(cal, dict) and cal.get("error") else "")
+        lines.append(f"ceiling: {block.get('ceiling_qps')} q/s "
+                     f"({block.get('bound_class')}{overlap}) "
+                     f"[calibration: absent{err}]")
     if block.get("roofline_pct") is not None:
         lines.append(f"measured: {block.get('measured_qps')} q/s = "
                      f"{block['roofline_pct'] * 100:.1f}% of roofline")

@@ -92,6 +92,13 @@ CURATED_FIELDS: Tuple[Tuple[str, str], ...] = (
     # a knee that slides down is a serving regression even when the
     # closed-loop headline holds
     ("knee_qps", "higher"),
+    # calibration drift (knn_tpu.obs.calibrate): |percent| the ANALYTIC
+    # roofline mispredicted the measured device time by, judged
+    # lower-is-better on the magnitude — a residual that GROWS across
+    # rounds means the model (or the machine) moved and the calibration
+    # campaign must re-run; curated_value takes the abs so a sign flip
+    # around zero never reads as an improvement
+    ("model_residual_pct", "lower"),
 )
 
 
@@ -120,6 +127,17 @@ def curated_value(rec: dict, fname: str):
                 pb = entry.get("phase_breakdown")
                 if isinstance(pb, dict):
                     v = pb.get("device_qps")
+    if fname == "model_residual_pct":
+        if v is None:
+            block = rec.get("roofline")
+            if isinstance(block, dict):
+                cal = block.get("calibration")
+                if isinstance(cal, dict):
+                    v = cal.get("model_residual_pct")
+        # drift magnitude: the residual is signed, the baseline judges
+        # how FAR from zero the model sits either way
+        if isinstance(v, (int, float)):
+            v = abs(v)
     return v
 
 #: verdict severity order (worst wins the overall verdict)

@@ -97,7 +97,7 @@ see ``KERNELS``):
   knob grid so the next TPU session measures it.
 
 Runs in interpret mode off-TPU so the CPU test suite covers it; the TPU
-session script (scripts/tpu_session.py) gates the *compiled* kernel against
+session script (scripts/archive/tpu_session.py) gates the *compiled* kernel against
 the float64 oracle before any benchmark run.
 """
 

@@ -23,7 +23,7 @@ The public entry points:
   in the CLI's JSON output).
 - ``python -m knn_tpu.cli tune`` — the command a TPU session runs once
   per shape, replacing the per-session hand search of
-  ``scripts/tpu_session_r5b.py``.
+  ``scripts/archive/tpu_session_r5b.py``.
 """
 
 from __future__ import annotations

@@ -63,7 +63,7 @@ def main() -> int:
     qv, dbv = aval(4096, 300), aval(1_183_514, 300)    # glove: 3 chunks
 
     cases = [
-        # the kernel A/B variant matrix (scripts/tpu_session.py kernel_ab)
+        # the kernel A/B variant matrix (scripts/archive/tpu_session.py kernel_ab)
         ("kernel lane t8192", _bin_candidates, (qs, db),
          dict(block_q=128, tile_n=8192, bin_w=128, survivors=2,
               precision="bf16x3", interpret=False, binning="lane")),
@@ -85,7 +85,7 @@ def main() -> int:
          (qs, db), dict(m=128, block_q=128, tile_n=16384,
                         final_select="exact", interpret=False,
                         binning="grouped")),
-        # the r5b follow-up grid (scripts/tpu_session_r5b.py): the
+        # the r5b follow-up grid (scripts/archive/tpu_session_r5b.py): the
         # t32768 x bq256 cross the r5a A/B never measured (32 MB score
         # tile — the largest VMEM geometry yet) and the bf16x3f fused
         # contraction, never timed on hardware (VERDICT r4 item 6)

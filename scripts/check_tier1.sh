@@ -33,6 +33,7 @@ if [ "${1:-}" = "--fast" ]; then
     tests/test_fused_overlap.py \
     tests/test_quantize.py tests/test_tuning.py tests/test_obs.py \
     tests/test_slo.py tests/test_sentinel.py tests/test_roofline.py \
+    tests/test_calibrate.py \
     tests/test_loadgen.py tests/test_admission.py \
     tests/test_waterfall.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly

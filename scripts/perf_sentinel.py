@@ -8,10 +8,13 @@ Three modes, all reading the repo's recorded bench history
     CI config validation: the SLO objectives (defaults or
     ``KNN_TPU_SLO_CONFIG``) parse and reference only cataloged metrics,
     the bench history parses into baselines, and every ``roofline`` /
-    ``loadgen_knee`` block a history line carries is structurally valid
-    (knn_tpu.obs.roofline.validate_block and
-    knn_tpu.loadgen.knee.validate_knee_block — a malformed block would
-    poison the roofline_pct / knee_qps baselines silently).  This is what
+    ``calibration`` / ``campaign`` / ``loadgen_knee`` block a history
+    line carries is structurally valid
+    (knn_tpu.obs.roofline.validate_block,
+    knn_tpu.obs.calibrate.validate_calibration /
+    validate_campaign_block, knn_tpu.loadgen.knee.validate_knee_block —
+    a malformed block would poison the roofline_pct /
+    model_residual_pct / knee_qps baselines silently).  This is what
     ``scripts/check_tier1.sh --fast`` runs — a broken SLO config or a
     corrupted history fixture fails here, not at serve time.
 
@@ -92,6 +95,36 @@ def run_lint(repo) -> int:
               f"{n_errored} advisory-error blocks skipped)")
     except Exception as e:  # noqa: BLE001
         errors.append(f"roofline blocks: {type(e).__name__}: {e}")
+    try:
+        from knn_tpu.obs import calibrate
+
+        n_cal, n_camp, n_before = 0, 0, len(errors)
+        for rec in records:
+            block = rec.get("roofline")
+            cal = block.get("calibration") if isinstance(block, dict) \
+                else None
+            if cal is not None and "error" not in block:
+                n_cal += 1
+                for err in calibrate.validate_calibration(cal):
+                    errors.append(
+                        f"calibration block on {rec.get('metric')} "
+                        f"({rec.get('_source')}): {err}")
+            camp = rec.get("campaign")
+            if camp is not None:
+                n_camp += 1
+                for err in calibrate.validate_campaign_block(camp):
+                    errors.append(
+                        f"campaign block on {rec.get('metric')} "
+                        f"({rec.get('_source')}): {err}")
+        if len(errors) == n_before:
+            print(f"calibration blocks: OK ({n_cal} calibration, "
+                  f"{n_camp} campaign validated)")
+        else:
+            print(f"calibration blocks: "
+                  f"{len(errors) - n_before} violation(s) across "
+                  f"{n_cal + n_camp} blocks")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"calibration blocks: {type(e).__name__}: {e}")
     try:
         from knn_tpu.loadgen.knee import validate_knee_block
 

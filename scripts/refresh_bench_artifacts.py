@@ -27,7 +27,11 @@ Fresh lines additionally carry a roofline attribution
 blocks REFUSED), pre-roofline lines back-derived from their own config
 fields, and ``roofline_pct``/``bound_class`` hoisted top-level for the
 sentinel's baselines; the per-line print shows the percent and bound
-class beside the sentinel verdict."""
+class beside the sentinel verdict.  MODEL_VERSION-3 lines carrying a
+``calibration`` verdict (or a ``campaign`` artifact block from
+``cli campaign``) are validated the same way — malformed ones REFUSED,
+``model_residual_pct`` hoisted, ``calib=RESIDUAL%`` printed beside the
+sentinel/roofline/knee readout."""
 import json
 import os
 import subprocess
@@ -203,6 +207,44 @@ except Exception as _e:  # noqa: BLE001 — curation must never fail on it
     print(f"roofline curation skipped: {type(_e).__name__}: {_e}",
           file=sys.stderr)
 
+# calibration + campaign curation (knn_tpu.obs.calibrate): a fresh
+# line's roofline block carrying a `calibration` verdict is validated
+# (malformed blocks REFUSED — a corrupt overlay claim would poison the
+# model_residual_pct baselines and let a line silently claim
+# calibrated), with the signed residual hoisted top-level for the
+# sentinel; a `campaign` artifact block (cli campaign) is REFUSED when
+# malformed, same discipline.
+try:
+    from knn_tpu.obs import calibrate as _calibrate
+
+    for cfg, rec in best.items():
+        if rec["stale"]:
+            continue  # a republished number keeps its old blocks verbatim
+        block = rec.get("roofline")
+        cal = block.get("calibration") if isinstance(block, dict) \
+            else None
+        if cal is not None and "error" not in block:
+            errs = _calibrate.validate_calibration(cal)
+            if errs:
+                sys.exit(f"refusing to emit curated line for {cfg}: "
+                         f"malformed calibration block: "
+                         f"{'; '.join(errs)}")
+            if cal.get("applied") and isinstance(
+                    cal.get("model_residual_pct"), (int, float)):
+                rec.setdefault("model_residual_pct",
+                               cal["model_residual_pct"])
+        camp = rec.get("campaign")
+        if camp is not None:
+            errs = _calibrate.validate_campaign_block(camp)
+            if errs:
+                sys.exit(f"refusing to emit curated line for {cfg}: "
+                         f"malformed campaign block: {'; '.join(errs)}")
+except SystemExit:
+    raise
+except Exception as _e:  # noqa: BLE001 — curation must never fail on it
+    print(f"calibration curation skipped: {type(_e).__name__}: {_e}",
+          file=sys.stderr)
+
 # knee curation (knn_tpu.loadgen.knee): a fresh line carrying a
 # loadgen_knee block (bench's knee mode / cli loadgen) is validated —
 # malformed blocks REFUSED, the roofline discipline: a corrupt block
@@ -270,6 +312,11 @@ with open(DST, "w") as f:
                  f"/{r.get('bound_class')}"
                  if isinstance(r.get("roofline_pct"), (int, float))
                  else "")
+              # the analytic model's measured residual, when the line's
+              # roofline block carries an applied calibration overlay
+              + (f" calib={r['model_residual_pct']}%"
+                 if isinstance(r.get("model_residual_pct"),
+                               (int, float)) else "")
               # the measured serving knee (loadgen sweep), when the
               # session ran one: max SLO-meeting sustained request rate
               + (f" knee={r['knee_qps']}q/s"

@@ -11,7 +11,7 @@
 #                            completed — bench lines are already banked
 #                            and a re-run would only re-spend the window)
 #           WATCH_SESSION    session script to run (default
-#                            scripts/tpu_session.py)
+#                            scripts/measured_ceiling_campaign.py)
 #           WATCH_STALL_MIN  minutes of FLAT CPU TIME before a running
 #                            session is declared wedged and SIGKILLed
 #                            (default 20).  Round-5 lesson: when the
@@ -50,7 +50,7 @@ STATE_DIR="${WATCH_STATE_DIR:-$REPO}"
 LOCK="$STATE_DIR/.tpu_session.pid"
 DONE="$STATE_DIR/.tpu_session.done"
 INTERVAL="${WATCH_INTERVAL:-300}"
-SESSION="${WATCH_SESSION:-scripts/tpu_session.py}"
+SESSION="${WATCH_SESSION:-scripts/measured_ceiling_campaign.py}"
 STALL_MIN="${WATCH_STALL_MIN:-20}"
 STALL_S="${WATCH_STALL_S:-$(( STALL_MIN * 60 ))}"
 POLL_S="${WATCH_POLL_S:-60}"
