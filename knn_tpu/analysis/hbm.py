@@ -1,0 +1,96 @@
+"""Analytic HBM byte accounting for database placements — the budget
+side of the host-RAM shard tier (the vmem.py discipline, one level up
+the memory hierarchy).
+
+``analysis.vmem`` prices a kernel launch's VMEM footprint; nothing
+priced the PLACEMENT's HBM footprint, yet that is what decides whether
+a corpus fits one serving replica at all: ``ShardedKNN`` places the
+full padded f32 database (plus, lazily, the int8 quantized copy), so
+the reachable corpus was capped at the mesh's HBM.  This module is the
+jax-free arithmetic the host-RAM tier plans against:
+
+- :func:`placement_bytes` — bytes one placed database occupies across
+  the mesh (values + the per-row norm/scale aux the search programs
+  keep warm), mirroring what ``ShardedKNN.__init__`` actually places;
+- :func:`plan_segments` — partition ``n_rows`` into equal row segments
+  whose per-host share fits a byte budget, each a multiple of the db
+  shard count so every sweep reuses ONE compiled program shape (the
+  flat-per-sweep-latency contract tests pin).
+
+Tests pin ``plan_segments``'s sweep count against the byte model and
+the boundary cases (corpus exactly at, one row over, many-x over the
+budget) in tests/test_hosttier.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+#: f32 aux bytes the placement keeps beside each row (the squared row
+#: norm the distance programs hoist); the int8 tier would add scales,
+#: but the host-RAM tier streams the f32 placement
+AUX_BYTES_PER_ROW = 4
+
+
+def placement_bytes(n_rows: int, dim: int, itemsize: int = 4) -> int:
+    """Total HBM bytes a ``[n_rows, dim]`` placement occupies across
+    the mesh: the value matrix at ``itemsize`` bytes/element plus the
+    per-row aux column."""
+    n_rows, dim = int(n_rows), int(dim)
+    if n_rows < 0 or dim <= 0:
+        raise ValueError(f"bad placement shape ({n_rows}, {dim})")
+    return n_rows * (dim * int(itemsize) + AUX_BYTES_PER_ROW)
+
+
+def rows_for_budget(budget_bytes: int, dim: int, *, itemsize: int = 4,
+                    hosts: int = 1, shard_multiple: int = 1) -> int:
+    """The largest row count whose PER-HOST placement share fits
+    ``budget_bytes``, rounded down to ``shard_multiple`` (the db shard
+    count — a segment must divide evenly across the db axis)."""
+    if budget_bytes <= 0:
+        raise ValueError(f"budget_bytes must be > 0, got {budget_bytes}")
+    per_row = dim * int(itemsize) + AUX_BYTES_PER_ROW
+    rows = (int(budget_bytes) * max(1, int(hosts))) // per_row
+    return (rows // shard_multiple) * shard_multiple
+
+
+def plan_segments(
+    n_rows: int, dim: int, budget_bytes: int, *, itemsize: int = 4,
+    hosts: int = 1, shard_multiple: int = 1,
+) -> List[Tuple[int, int]]:
+    """``[(lo, hi), ...]`` row segments covering ``[0, n_rows)``, every
+    segment's per-host placed bytes within ``budget_bytes`` and every
+    segment the SAME padded width (``segment_rows``; the tail is ragged
+    in valid rows but pads to the same shape so all sweeps share one
+    compiled program).  Raises when the budget cannot hold even one
+    ``shard_multiple`` of rows — a budget that small cannot stream."""
+    n_rows = int(n_rows)
+    if n_rows <= 0:
+        raise ValueError(f"n_rows must be > 0, got {n_rows}")
+    seg = rows_for_budget(budget_bytes, dim, itemsize=itemsize,
+                          hosts=hosts, shard_multiple=shard_multiple)
+    if seg < shard_multiple or seg < 1:
+        raise ValueError(
+            f"hbm budget {budget_bytes} B/host cannot hold even "
+            f"{shard_multiple} rows of dim {dim} at {itemsize} B/elem; "
+            f"raise the budget or use fewer db shards")
+    seg = min(seg, -(-n_rows // shard_multiple) * shard_multiple)
+    return [(lo, min(lo + seg, n_rows)) for lo in range(0, n_rows, seg)]
+
+
+def n_sweeps(n_rows: int, dim: int, budget_bytes: int, *,
+             itemsize: int = 4, hosts: int = 1,
+             shard_multiple: int = 1) -> int:
+    """Sweep count the plan implies — what tests pin the executed sweep
+    counter against."""
+    return len(plan_segments(n_rows, dim, budget_bytes, itemsize=itemsize,
+                             hosts=hosts, shard_multiple=shard_multiple))
+
+
+__all__ = [
+    "AUX_BYTES_PER_ROW",
+    "placement_bytes",
+    "rows_for_budget",
+    "plan_segments",
+    "n_sweeps",
+]

@@ -123,6 +123,23 @@ SWITCHES: Tuple[Switch, ...] = (
     _s("KNN_TPU_PIPELINE_DEPTH", "int", "knn_tpu/parallel/sharded.py",
        _OBS, "Bounded in-flight batch depth of the pipelined path "
        "(default 2)."),
+    # --- multi-host merge tree (knn_tpu.parallel.crossover) ------------
+    _s("KNN_TPU_MERGE", "str", "knn_tpu/parallel/crossover.py", _PERF,
+       "Override the measured ring/allgather crossover for the "
+       "flat / per-host ICI merge level (explicit caller arg still "
+       "wins; malformed values raise)."),
+    _s("KNN_TPU_DCN_MERGE", "str", "knn_tpu/parallel/crossover.py",
+       _PERF, "Same override for the cross-host DCN merge level of "
+       "hierarchical placements."),
+    # --- host-RAM shard tier (knn_tpu.parallel.sharded) ----------------
+    _s("KNN_TPU_HOSTTIER_BUDGET_BYTES", "int",
+       "knn_tpu/parallel/sharded.py", _PERF,
+       "Per-host HBM byte budget: a corpus placing past it serves "
+       "from host RAM, streamed segment-by-segment (unset = "
+       "unbounded, everything resident)."),
+    _s("KNN_TPU_HOSTTIER_DEPTH", "int", "knn_tpu/parallel/sharded.py",
+       _PERF, "Bounded in-flight sweep depth of the host-RAM tier's "
+       "dispatch-ahead stream (default 2)."),
     # --- admission control (knn_tpu.serving.admission) -----------------
     _s("KNN_TPU_ADMISSION_", "family", "knn_tpu/serving/admission.py",
        _SERVING, "Admission-control knob family (ANY set member is an "
@@ -153,7 +170,13 @@ SWITCHES: Tuple[Switch, ...] = (
        "Named benchmark config: sift1m (default) | glove | gist1m."),
     _s("KNN_BENCH_MODES", "spec", "bench.py", _PERF,
        "Comma list of modes to run (exact, certified_approx, "
-       "certified_pallas, serving, knee)."),
+       "certified_pallas, serving, knee, multihost)."),
+    _s("KNN_BENCH_MULTIHOST_HOSTS", "int", "bench.py", _PERF,
+       "Host-axis size of the multihost mode's hierarchical mesh "
+       "(default 2)."),
+    _s("KNN_BENCH_MULTIHOST_SWEEPS", "int", "bench.py", _PERF,
+       "Target host-RAM tier sweep count of the multihost mode's "
+       "budget-forced stream (default 4)."),
     _s("KNN_BENCH_RUNS", "int", "bench.py", _PERF,
        "Timed repetitions per mode (default 5)."),
     _s("KNN_BENCH_N", "int", "bench.py", _PERF, "Database rows."),

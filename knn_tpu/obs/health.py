@@ -294,7 +294,23 @@ def report(slo_section: Optional[dict] = None,
         "slowest_requests": (_slowest_requests() if slowest is None
                              else slowest),
         "postmortems": _postmortems(),
+        # multi-host serving: the last cross-host merge's straggler
+        # attribution (per-host walls, gap, DCN volume/strategy) —
+        # None until a MultiHostKNN merge ran in this process
+        "multihost": _multihost_status(),
     }
+
+
+def _multihost_status() -> Optional[dict]:
+    """The parallel.multihost last-merge report, import-guarded so a
+    jax-free doctor render of a snapshot never pays (or breaks on) the
+    jax import."""
+    try:
+        from knn_tpu.parallel import multihost
+
+        return multihost.last_report()
+    except Exception:  # noqa: BLE001 — introspection must not kill /statusz
+        return None
 
 
 def report_from_snapshot(payload: dict) -> dict:
@@ -321,6 +337,7 @@ def report_from_snapshot(payload: dict) -> dict:
                     "reason": "not recorded in this snapshot"},
         "engines": [], "queues": [],
         "tune_cache": {}, "roofline": {}, "calibration": {}, "slo": {},
+        "multihost": None,
         "active_breaches": [], "alerts": [],
         "slowest_requests": [], "postmortems": {},
     }
@@ -398,6 +415,15 @@ def render_text(rep: dict) -> str:
         lines.append("calibration: no store configured "
                      "(KNN_TPU_CALIBRATION unset) — roofline verdicts "
                      "are analytic only")
+    mh = rep.get("multihost")
+    if mh:
+        walls = mh.get("host_walls_s") or []
+        lines.append(
+            f"multihost: {mh.get('hosts')} host(s) "
+            f"[{mh.get('transport')}] dcn_merge={mh.get('dcn_merge')} "
+            f"bytes={mh.get('dcn_merge_bytes')} "
+            f"straggler_gap={mh.get('straggler_gap_s')}s "
+            f"(walls {', '.join(str(w) for w in walls)})")
     breaches = rep.get("active_breaches", [])
     lines.append(f"slo breaches: {', '.join(breaches) if breaches else 'none'}")
     def _slo_line(name, o, indent="  "):

@@ -104,6 +104,16 @@ CALIBRATION_RESIDUAL = "knn_tpu_calibration_residual_pct"
 CAMPAIGN_ARMS = "knn_tpu_campaign_arms_total"
 CAMPAIGN_STAGES = "knn_tpu_campaign_stages_total"
 
+# --- multi-host merge tree (knn_tpu.parallel.sharded / .multihost) -----
+MERGE_SELECTED = "knn_tpu_merge_strategy_selected_total"
+MERGE_BYTES = "knn_tpu_merge_bytes_total"
+MERGE_STRAGGLER_GAP = "knn_tpu_merge_straggler_gap_seconds"
+
+# --- host-RAM shard tier (knn_tpu.parallel.sharded) --------------------
+HOSTTIER_SWEEPS = "knn_tpu_hosttier_sweeps_total"
+HOSTTIER_SEGMENT_ROWS = "knn_tpu_hosttier_segment_rows"
+HOSTTIER_SWEEP_SECONDS = "knn_tpu_hosttier_sweep_seconds"
+
 #: name -> (type, label names, help).  Types: "counter" (monotone,
 #: float-valued so second-counters work), "gauge", "histogram" (bounded
 #: sample window + lifetime count/sum; exported as a Prometheus summary).
@@ -322,4 +332,32 @@ CATALOG = {
         "counter", ("stage",),
         "Campaign pipeline stages executed (gates / tune / bench / "
         "capture / reconcile / calibrate / curate), across arms."),
+    MERGE_SELECTED: (
+        "counter", ("level", "strategy", "source"),
+        "Merge-strategy resolutions at placement time, by merge level "
+        "(intra = per-host ICI db axis, dcn = cross-host) x chosen "
+        "strategy (ring / allgather) x provenance (explicit caller / "
+        "env switch / measured crossover table)."),
+    MERGE_BYTES: (
+        "counter", ("level", "strategy"),
+        "Modeled candidate bytes moved by top-k merges "
+        "(parallel.crossover.merge_bytes), by level and strategy — "
+        "the DCN volume the roofline's dcn term prices."),
+    MERGE_STRAGGLER_GAP: (
+        "gauge", (),
+        "Max-minus-min per-host local search wall time of the last "
+        "cross-host merge (parallel.multihost) — the straggler signal "
+        "/statusz and doctor attribute."),
+    HOSTTIER_SWEEPS: (
+        "counter", (),
+        "Host-RAM tier segment sweeps executed: one per super-HBM "
+        "db segment streamed through the device placement."),
+    HOSTTIER_SEGMENT_ROWS: (
+        "gauge", (),
+        "Padded rows per host-RAM tier segment of the last planned "
+        "sweep (every sweep reuses this one compiled shape)."),
+    HOSTTIER_SWEEP_SECONDS: (
+        "histogram", (),
+        "Wall seconds per host-RAM tier sweep (dispatch to fetch of "
+        "one segment) — flat across sweeps when the stream overlaps."),
 }

@@ -87,10 +87,21 @@ from knn_tpu.obs import names, registry, trace
 #: can never silently claim calibrated).  The ``estimated`` flag keeps
 #: its PR-6 semantics either way: it names the PEAK TABLE's provenance,
 #: not the overlay's.
-MODEL_VERSION = 3
+#: 4 = the multi-host DCN merge term: blocks modeled with ``db_hosts >
+#: 1`` gain a ``terms.dcn`` entry pricing the cross-host top-k merge
+#: volume (parallel.crossover.merge_bytes at the chosen ring/allgather
+#: strategy) against the per-host DCN bandwidth — serialized AFTER the
+#: per-host compute (a global merge cannot complete before its inputs),
+#: so ``ceiling_qps = nq / (combined_compute_time + t_dcn)`` and
+#: ``bound_class`` may read ``dcn_bound``.  Single-host blocks are
+#: numerically unchanged; the bump re-keys the tuning cache and
+#: calibration store so pre-DCN attributions self-invalidate.
+MODEL_VERSION = 4
 
-#: the three resources a config can exhaust, in tie-break order
-BOUND_CLASSES = ("hbm_bound", "mxu_bound", "vpu_select_bound")
+#: the resources a config can exhaust, in tie-break order (dcn_bound
+#: only appears on multi-host blocks, db_hosts > 1)
+BOUND_CLASSES = ("hbm_bound", "mxu_bound", "vpu_select_bound",
+                 "dcn_bound")
 
 #: per-device-kind peaks (public spec sheets; bf16 column = the table
 #: bench.py carried since round 1, now living here).  ``hbm_gbps`` is
@@ -141,8 +152,28 @@ PEAKS_BY_KIND: Dict[str, Dict[str, float]] = {
 #: being attribution-blind, not to be defended to a digit.
 GENERIC_CPU_PEAKS: Dict[str, float] = {
     "bf16_flops": 100e9, "int8_flops": 200e9,
-    "hbm_gbps": 25.0, "vpu_ops": 50e9,
+    "hbm_gbps": 25.0, "vpu_ops": 50e9, "dcn_gbps": 5.0,
 }
+
+#: per-host DCN bandwidth (GB/s) by device kind for the cross-host
+#: merge term — ESTIMATED from public inter-slice networking figures
+#: (~100-200 Gbps NICs per host on v4+ pods, less on v2/v3); like
+#: ``vpu_ops`` these exist to rank configurations and name the bound,
+#: not to be defended to a digit.  Kinds absent here fall back to
+#: DCN_GBPS_DEFAULT.
+DCN_GBPS_BY_KIND: Dict[str, float] = {
+    "TPU v2": 12.5, "TPU v3": 12.5,
+}
+DCN_GBPS_DEFAULT = 25.0
+
+
+def dcn_gbps_for(device_kind, peaks) -> float:
+    """The per-host DCN bandwidth a block's dcn term divides by:
+    an explicit ``dcn_gbps`` in a caller-supplied peaks dict wins,
+    else the kind table, else the v4+ default."""
+    if peaks and "dcn_gbps" in peaks:
+        return float(peaks["dcn_gbps"])
+    return DCN_GBPS_BY_KIND.get(device_kind or "", DCN_GBPS_DEFAULT)
 
 #: db operand stream width per element, by kernel matmul precision —
 #: EXACTLY what ops.pallas_knn._bin_candidates builds: bf16x3 streams
@@ -256,10 +287,14 @@ def db_operand_nbytes(n: int, d: int, precision: str) -> Dict[str, int]:
 
 
 def _combined(times: Dict[str, float], select_overlapped: bool) -> float:
+    # the DCN merge serializes AFTER the per-host compute: a global
+    # merge cannot complete before its inputs exist
+    t_dcn = times.get("dcn_bound", 0.0)
+    compute = {k: v for k, v in times.items() if k != "dcn_bound"}
     if select_overlapped:
-        return max(times.values())
-    return max(times["hbm_bound"], times["mxu_bound"]) + \
-        times["vpu_select_bound"]
+        return max(compute.values()) + t_dcn
+    return max(compute["hbm_bound"], compute["mxu_bound"]) + \
+        compute["vpu_select_bound"] + t_dcn
 
 
 def _terms_to_verdict(model: dict, nq: int,
@@ -285,7 +320,9 @@ def _terms_to_verdict(model: dict, nq: int,
         "mxu_bound": terms["mxu"]["time_s"],
         "vpu_select_bound": terms["vpu_select"]["time_s"],
     }
-    bound = max(BOUND_CLASSES, key=lambda c: (times[c], -BOUND_CLASSES.index(c)))
+    if "dcn" in terms:
+        times["dcn_bound"] = terms["dcn"]["time_s"]
+    bound = max(times, key=lambda c: (times[c], -BOUND_CLASSES.index(c)))
     t = _combined(times, select_overlapped)
     model["bound_class"] = bound
     model["select_overlapped"] = bool(select_overlapped)
@@ -304,6 +341,14 @@ def _consult_calibration(model: dict, nq: int,
     the block — the model must render even when the overlay cannot."""
     from knn_tpu.obs import calibrate
 
+    if "dcn_bound" in times:
+        # multi-host blocks: no calibration entry covers the DCN term
+        # yet (the campaign measures single-host arms); an explicit
+        # absent verdict beats silently mis-scaling three of four terms
+        model["calibration"] = {
+            "applied": False,
+            "note": "multi-host blocks use the analytic DCN model"}
+        return
     try:
         entry = calibrate.lookup_for_block(model)
     except Exception as e:  # noqa: BLE001 — overlay must not kill the model
@@ -337,7 +382,7 @@ def _consult_calibration(model: dict, nq: int,
         return
     model["ceiling_qps"] = round(nq / t, 1)
     model["bound_class"] = max(
-        BOUND_CLASSES,
+        cal_times,
         key=lambda c: (cal_times[c], -BOUND_CLASSES.index(c)))
     model["term_times_calibrated_s"] = {
         k: round(v, 6) for k, v in cal_times.items()}
@@ -355,6 +400,29 @@ def _consult_calibration(model: dict, nq: int,
     }
 
 
+def _dcn_term(nq: int, k: int, db_hosts: int, dcn_merge: Optional[str],
+              device_kind, peaks) -> Optional[dict]:
+    """The MODEL_VERSION-4 cross-host merge term, or None on a
+    single-host config: the hierarchical merge's DCN candidate volume
+    (parallel.crossover.merge_bytes at the resolved strategy) over the
+    per-host DCN bandwidth."""
+    hosts = max(1, int(db_hosts))
+    if hosts <= 1:
+        return None
+    from knn_tpu.parallel import crossover
+
+    strategy = dcn_merge or crossover.choose_merge(k, hosts)
+    nbytes = crossover.merge_bytes(nq, k, hosts, strategy)
+    rate = dcn_gbps_for(device_kind, peaks)
+    return {
+        "bytes": int(nbytes),
+        "strategy": strategy,
+        "hosts": hosts,
+        "rate_gbps": rate,
+        "time_s": nbytes / (rate * 1e9),
+    }
+
+
 def pallas_cost_model(
     *, n: int, d: int, k: int, nq: int,
     precision: Optional[str] = None, kernel: Optional[str] = None,
@@ -363,13 +431,18 @@ def pallas_cost_model(
     survivors: Optional[int] = None, margin: int = 28,
     device_kind: Optional[str] = None, backend: Optional[str] = None,
     num_devices: int = 1, peaks: Optional[Dict[str, float]] = None,
+    db_hosts: int = 1, dcn_merge: Optional[str] = None,
 ) -> dict:
     """The roofline model of one Pallas-selector config (see module
     docstring for the terms).  ``None`` knobs take the library defaults
     the kernel itself would (tile 16384, block_q 128, grouped
     survivors 2).  Sharding is modeled as perfect scaling: each of
     ``num_devices`` devices streams ``n / num_devices`` rows in
-    parallel."""
+    parallel.  ``db_hosts > 1`` adds the cross-host DCN merge term
+    (MODEL_VERSION 4): the hierarchical top-k merge ships each host's
+    ``[nq, k]`` candidate list over DCN at the ``dcn_merge`` strategy
+    (None = the measured crossover pick), serialized after the
+    per-host compute."""
     precision = precision or "bf16x3"
     kernel = kernel or "tiled"
     if kernel not in ("tiled", "streaming", "fused"):
@@ -454,6 +527,7 @@ def pallas_cost_model(
             "grid_order": grid_order, "binning": binning,
             "tile_n": tile, "block_q": bq, "survivors": surv,
             "margin": int(margin), "num_devices": int(num_devices),
+            "db_hosts": max(1, int(db_hosts)),
         },
         "terms": {
             "hbm": {
@@ -476,6 +550,9 @@ def pallas_cost_model(
             },
         },
     }
+    dcn = _dcn_term(nq, k, db_hosts, dcn_merge, device_kind, peaks)
+    if dcn is not None:
+        model["terms"]["dcn"] = dcn
     # the fused kernel's in-loop select rides the HBM stream's shadow
     # (its early-out makes the 12-op calibration an upper bound there —
     # skipped tiles pay ~1 op/elem, unmodelable statically); the
@@ -499,6 +576,7 @@ def xla_cost_model(
     margin: int = 28, device_kind: Optional[str] = None,
     backend: Optional[str] = None, num_devices: int = 1,
     peaks: Optional[Dict[str, float]] = None,
+    db_hosts: int = 1, dcn_merge: Optional[str] = None,
 ) -> dict:
     """Roofline for the XLA selectors: ``exact`` (coarse ``lax.top_k``,
     one db pass) and ``approx`` (ApproxTopK coarse + the count-below
@@ -546,6 +624,7 @@ def xla_cost_model(
             "n": int(n), "d": int(d), "k": int(k), "nq": int(nq),
             "dtype": dtype, "batch": bs, "passes": passes,
             "margin": int(margin), "num_devices": int(num_devices),
+            "db_hosts": max(1, int(db_hosts)),
         },
         "terms": {
             "hbm": {
@@ -569,6 +648,9 @@ def xla_cost_model(
             },
         },
     }
+    dcn = _dcn_term(nq, k, db_hosts, dcn_merge, device_kind, peaks)
+    if dcn is not None:
+        model["terms"]["dcn"] = dcn
     _terms_to_verdict(model, nq)
     return model
 
@@ -626,6 +708,29 @@ def validate_block(block) -> list:
                     not isinstance(t.get("time_s"), (int, float)) or \
                     t["time_s"] < 0:
                 errors.append(f"terms.{term}.time_s missing or negative")
+        dcn = terms.get("dcn")
+        if dcn is not None:
+            # the MODEL_VERSION-4 cross-host merge term: present only
+            # on multi-host blocks, and then every field must hold —
+            # a malformed DCN claim would poison curated baselines
+            from knn_tpu.parallel.crossover import STRATEGIES
+
+            if not isinstance(dcn, dict):
+                errors.append("terms.dcn is not a dict")
+            else:
+                if not isinstance(dcn.get("time_s"), (int, float)) or \
+                        dcn["time_s"] < 0:
+                    errors.append("terms.dcn.time_s missing or negative")
+                if not isinstance(dcn.get("bytes"), int) or \
+                        dcn["bytes"] < 0:
+                    errors.append("terms.dcn.bytes missing or negative")
+                if not isinstance(dcn.get("hosts"), int) or \
+                        dcn["hosts"] < 2:
+                    errors.append("terms.dcn.hosts must be an int >= 2")
+                if dcn.get("strategy") not in STRATEGIES:
+                    errors.append(
+                        f"terms.dcn.strategy {dcn.get('strategy')!r} "
+                        f"not in {STRATEGIES}")
     # MODEL_VERSION 3 blocks carry an explicit calibration verdict;
     # pre-calibration history blocks (v1/v2) legitimately lack it, but
     # one that IS present must be well-formed — a malformed overlay
@@ -807,6 +912,13 @@ def render_text(block: dict) -> str:
         f"-> {vp.get('time_s', 0) * 1e3:9.3f} ms   "
         f"({vp.get('ops_per_elem')} ops/elem at "
         f"{vp.get('rate_ops', 0) / 1e12:.1f} Tops/s)")
+    dc = terms.get("dcn")
+    if dc:
+        lines.append(
+            f"  dcn:        {dc.get('bytes', 0) / 1e6:10.3f} MB  "
+            f"-> {dc.get('time_s', 0) * 1e3:9.3f} ms   "
+            f"({dc.get('hosts')} hosts, {dc.get('strategy')} merge at "
+            f"{dc.get('rate_gbps')} GB/s)")
     overlap = (" select overlapped" if block.get("select_overlapped")
                else "")
     cal = block.get("calibration")

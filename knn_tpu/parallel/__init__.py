@@ -17,46 +17,54 @@ lives in :mod:`knn_tpu.parallel.multihost`: initialize / global_mesh /
 shard_across_hosts / process_row_slice.
 """
 
-from knn_tpu.parallel.mesh import (
-    make_mesh,
-    default_mesh,
-    pad_to_multiple,
-    QUERY_AXIS,
-    DB_AXIS,
-)
-from knn_tpu.parallel.collectives import (
-    replicate,
-    shard,
-    gather,
-    allreduce_min,
-    allreduce_max,
-    barrier,
-    shard_map_compat,
-)
-from knn_tpu.parallel.sharded import (
-    ShardedKNN,
-    sharded_knn,
-    sharded_knn_predict,
-    sharded_minmax,
-    sharded_normalize_transductive,
-)
+# Attribute access is lazy (PEP 562, the knn_tpu/__init__ idiom) so the
+# jax-free members — parallel.crossover's measured table, validators,
+# and byte models, consumed by the artifact refresher and the roofline
+# model — never pay (or break on) the JAX import the mesh/collective/
+# SPMD members need.
+import importlib
 
-__all__ = [
-    "make_mesh",
-    "default_mesh",
-    "pad_to_multiple",
-    "QUERY_AXIS",
-    "DB_AXIS",
-    "replicate",
-    "shard",
-    "gather",
-    "allreduce_min",
-    "allreduce_max",
-    "barrier",
-    "shard_map_compat",
-    "ShardedKNN",
-    "sharded_knn",
-    "sharded_knn_predict",
-    "sharded_minmax",
-    "sharded_normalize_transductive",
-]
+#: symbol -> defining submodule; resolved on first attribute access
+_EXPORTS = {
+    "make_mesh": "knn_tpu.parallel.mesh",
+    "make_host_mesh": "knn_tpu.parallel.mesh",
+    "default_mesh": "knn_tpu.parallel.mesh",
+    "pad_to_multiple": "knn_tpu.parallel.mesh",
+    "QUERY_AXIS": "knn_tpu.parallel.mesh",
+    "DB_AXIS": "knn_tpu.parallel.mesh",
+    "HOST_AXIS": "knn_tpu.parallel.mesh",
+    "MEASURED_CROSSOVER": "knn_tpu.parallel.crossover",
+    "choose_merge": "knn_tpu.parallel.crossover",
+    "merge_bytes": "knn_tpu.parallel.crossover",
+    "resolve_merge": "knn_tpu.parallel.crossover",
+    "replicate": "knn_tpu.parallel.collectives",
+    "shard": "knn_tpu.parallel.collectives",
+    "gather": "knn_tpu.parallel.collectives",
+    "allreduce_min": "knn_tpu.parallel.collectives",
+    "allreduce_max": "knn_tpu.parallel.collectives",
+    "barrier": "knn_tpu.parallel.collectives",
+    "shard_map_compat": "knn_tpu.parallel.collectives",
+    "ShardedKNN": "knn_tpu.parallel.sharded",
+    "sharded_knn": "knn_tpu.parallel.sharded",
+    "sharded_knn_predict": "knn_tpu.parallel.sharded",
+    "sharded_minmax": "knn_tpu.parallel.sharded",
+    "sharded_normalize_transductive": "knn_tpu.parallel.sharded",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'knn_tpu.parallel' has no attribute {name!r}"
+        ) from None
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

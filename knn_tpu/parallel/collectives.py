@@ -26,6 +26,17 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# The measured ring/allgather crossover (SCALING.json) lives jax-free in
+# parallel.crossover so the artifact refresher and the roofline model
+# can read it without a backend; re-exported here because strategy
+# choice is a property of this collective surface.
+from knn_tpu.parallel.crossover import (  # noqa: F401  (re-export)
+    MEASURED_CROSSOVER,
+    choose_merge,
+    merge_bytes,
+    resolve_merge,
+)
+
 
 def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
     """``jax.shard_map`` across the API move: top-level ``jax.shard_map``

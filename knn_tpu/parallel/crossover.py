@@ -1,0 +1,188 @@
+"""The measured ring/allgather crossover — merge-strategy selection as
+DATA, not caller folklore.
+
+``SCALING.json`` (scripts/scaling_study.py) measured both db-axis merge
+strategies at equal total work across mesh shapes and k.  The verdict
+is a crossover, not a winner: allgather's one-collective P·k candidate
+volume wins at small shard counts and large ones whose ring would pay
+P-1 latency hops, while the ring's constant-memory (P-1)·k pipeline
+wins in between and at large k where the gathered volume dominates.
+Until this module, that measurement drove nothing — ``merge=`` was a
+caller-chosen kwarg defaulting to allgather everywhere.
+
+This is the jax-free home of
+
+- :data:`MEASURED_CROSSOVER` — the argmin-wall strategy per measured
+  ``(k, shards)`` point, pinned against ``SCALING.json`` itself by
+  tests/test_collectives.py (edit the JSON and the table must follow);
+- :func:`choose_merge` / :func:`resolve_merge` — nearest-measured-point
+  lookup with the precedence **explicit caller > env switch
+  (``KNN_TPU_MERGE`` / ``KNN_TPU_DCN_MERGE``) > measured table**;
+- :func:`merge_bytes` — the collective-volume model behind the
+  ``merge_bytes_per_sweep`` column (allgather moves ``Q·k·8·P`` bytes,
+  ring ``Q·k·8·(P-1)``; 8 = f32 distance + i32 index per candidate),
+  reused by the roofline's DCN term;
+- :func:`validate_multihost_block` — structural validation of the
+  ``multihost`` block bench.py emits and the artifact refresher
+  refuses when malformed (the roofline-block discipline).
+
+Everything here is plain arithmetic on plain numbers so the refresher,
+the sentinel lint, and the roofline model import it without JAX.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, Optional, Tuple
+
+#: the two db-axis merge strategies (mirrors parallel.sharded._MERGES)
+STRATEGIES = ("allgather", "ring")
+
+#: where a resolved strategy came from, in precedence order
+SOURCES = ("explicit", "env", "measured")
+
+#: env switches overriding the measured default at each merge level
+#: (the flat/intra-host ICI level and the cross-host DCN level) —
+#: cataloged in knn_tpu.analysis.switches
+MERGE_ENV = "KNN_TPU_MERGE"
+DCN_MERGE_ENV = "KNN_TPU_DCN_MERGE"
+
+#: bytes one (distance f32, index i32) candidate pair moves
+CANDIDATE_BYTES = 8
+
+#: ``(k, shards) -> strategy``: the argmin-wall_s strategy at every
+#: measured SCALING.json point (mesh column "QxP" contributes P).
+#: tests/test_collectives.py re-derives this from the JSON — the table
+#: can never silently drift from the measurement it claims to persist.
+MEASURED_CROSSOVER: Dict[Tuple[int, int], str] = {
+    (10, 2): "allgather",
+    (10, 4): "ring",
+    (10, 8): "allgather",
+    (100, 2): "ring",
+    (100, 4): "ring",
+    (100, 8): "allgather",
+}
+
+
+def _nearest(value: int, measured) -> int:
+    """The measured grid point nearest ``value`` in log space (both
+    axes are geometric: k 10/100, shards 2/4/8); ties take the smaller
+    point — the conservative, lower-volume regime."""
+    v = math.log(max(1, int(value)))
+    return min(sorted(set(measured)), key=lambda m: (abs(math.log(m) - v), m))
+
+
+def choose_merge(k: int, shards: int) -> str:
+    """The measured-table strategy for a ``(k, shards)`` merge — the
+    nearest measured point's argmin.  ``shards <= 1`` needs no merge;
+    allgather (a no-op there) is returned for uniformity."""
+    if shards <= 1:
+        return "allgather"
+    ks = {mk for mk, _ in MEASURED_CROSSOVER}
+    ps = {mp for _, mp in MEASURED_CROSSOVER}
+    return MEASURED_CROSSOVER[(_nearest(k, ks), _nearest(shards, ps))]
+
+
+def resolve_merge(
+    explicit: Optional[str], k: int, shards: int, *,
+    env_name: str = MERGE_ENV,
+) -> Tuple[str, str]:
+    """``(strategy, source)`` under the precedence explicit > env >
+    measured table.  A malformed env value raises rather than silently
+    steering a merge (the admission-control strict-env discipline)."""
+    if explicit is not None:
+        if explicit not in STRATEGIES:
+            raise ValueError(
+                f"unknown merge {explicit!r}; expected one of {STRATEGIES}")
+        return explicit, "explicit"
+    env = os.environ.get(env_name, "").strip().lower()
+    if env:
+        if env not in STRATEGIES:
+            raise ValueError(
+                f"{env_name}={env!r} is not one of {STRATEGIES}")
+        return env, "env"
+    return choose_merge(k, shards), "measured"
+
+
+def merge_bytes(n_queries: int, k: int, shards: int, strategy: str) -> int:
+    """Total candidate bytes one merge moves across the axis for a
+    ``[n_queries, k]`` result: allgather ships every shard's list to
+    every shard (``Q·k·8·P``), the ring passes a constant buffer P-1
+    hops (``Q·k·8·(P-1)``).  Reproduces SCALING.json's
+    ``merge_bytes_per_sweep`` column exactly (pinned in tests)."""
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            f"unknown merge {strategy!r}; expected one of {STRATEGIES}")
+    if shards <= 1:
+        return 0
+    hops = shards if strategy == "allgather" else shards - 1
+    return int(n_queries) * int(k) * CANDIDATE_BYTES * hops
+
+
+def validate_multihost_block(block) -> list:
+    """Structural validation of a ``multihost`` bench block.  Returns a
+    list of error strings, empty when well-formed — the artifact
+    refresher REFUSES malformed blocks (the roofline/knee discipline:
+    a corrupt block would poison curated baselines silently)."""
+    errors = []
+    if not isinstance(block, dict):
+        return [f"multihost block is {type(block).__name__}, not dict"]
+    hosts = block.get("hosts")
+    if not isinstance(hosts, int) or hosts < 1:
+        errors.append(f"hosts {hosts!r} is not a positive int")
+    chips = block.get("chips_per_host")
+    if chips is not None and (not isinstance(chips, int) or chips < 1):
+        errors.append(f"chips_per_host {chips!r} is not a positive int")
+    merge = block.get("merge")
+    if not isinstance(merge, dict):
+        errors.append("missing merge breakdown")
+    else:
+        for level in ("intra", "dcn"):
+            rec = merge.get(level)
+            if rec is None:
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"merge.{level} is not a dict")
+                continue
+            if rec.get("strategy") not in STRATEGIES:
+                errors.append(
+                    f"merge.{level}.strategy {rec.get('strategy')!r} "
+                    f"not in {STRATEGIES}")
+            if rec.get("source") not in SOURCES:
+                errors.append(
+                    f"merge.{level}.source {rec.get('source')!r} "
+                    f"not in {SOURCES}")
+    db = block.get("dcn_merge_bytes")
+    if db is not None and (not isinstance(db, int) or db < 0):
+        errors.append(f"dcn_merge_bytes {db!r} is not a non-negative int")
+    ht = block.get("hosttier")
+    if ht is not None:
+        if not isinstance(ht, dict):
+            errors.append("hosttier is not a dict")
+        else:
+            sw = ht.get("sweeps")
+            if not isinstance(sw, int) or sw < 1:
+                errors.append(f"hosttier.sweeps {sw!r} is not a positive int")
+            bb = ht.get("budget_bytes")
+            if not isinstance(bb, int) or bb <= 0:
+                errors.append(
+                    f"hosttier.budget_bytes {bb!r} is not a positive int")
+            sr = ht.get("segment_rows")
+            if not isinstance(sr, int) or sr < 1:
+                errors.append(
+                    f"hosttier.segment_rows {sr!r} is not a positive int")
+    return errors
+
+
+__all__ = [
+    "STRATEGIES",
+    "SOURCES",
+    "MERGE_ENV",
+    "DCN_MERGE_ENV",
+    "MEASURED_CROSSOVER",
+    "choose_merge",
+    "resolve_merge",
+    "merge_bytes",
+    "validate_multihost_block",
+]

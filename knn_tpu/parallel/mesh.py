@@ -27,6 +27,12 @@ from jax.sharding import Mesh
 
 QUERY_AXIS = "query"
 DB_AXIS = "db"
+#: the cross-host axis of a hierarchical mesh (make_host_mesh): db rows
+#: shard over (HOST_AXIS, DB_AXIS) — host-major, so each host's
+#: contiguous row block subdivides across its own chips.  Merges then
+#: go per-chip -> per-host over ICI (DB_AXIS) and per-host -> global
+#: over DCN (HOST_AXIS); see parallel.sharded.
+HOST_AXIS = "host"
 
 
 def make_mesh(
@@ -53,6 +59,61 @@ def make_mesh(
         raise ValueError(f"mesh {query_shards}x{db_shards} needs {need} devices, have {n}")
     grid = np.asarray(devices[:need]).reshape(query_shards, db_shards)
     return Mesh(grid, (QUERY_AXIS, DB_AXIS))
+
+
+def make_host_mesh(
+    query_shards: Optional[int] = None,
+    db_hosts: int = 1,
+    db_shards: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 3-D hierarchical ``Mesh`` with axes ``(QUERY_AXIS, HOST_AXIS,
+    DB_AXIS)``: database rows shard over hosts (DCN boundary, major)
+    then over each host's chips (ICI, minor).  On real pods pass
+    ``devices=jax.devices()`` (the global, process-spanning list) with
+    ``db_hosts = jax.process_count()``; single-process, the host axis
+    is a logical fold of the local devices — same SPMD program, same
+    merge tree, pinned bitwise-identical to the flat mesh in
+    tests/test_multihost.py."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if db_hosts < 1 or db_shards < 1:
+        raise ValueError(
+            f"db_hosts={db_hosts} and db_shards={db_shards} must be >= 1")
+    per_q = db_hosts * db_shards
+    if query_shards is None:
+        if n % per_q:
+            raise ValueError(
+                f"{n} devices not divisible by db_hosts*db_shards={per_q}")
+        query_shards = n // per_q
+    need = query_shards * per_q
+    if need > n:
+        raise ValueError(
+            f"mesh {query_shards}x{db_hosts}x{db_shards} needs {need} "
+            f"devices, have {n}")
+    grid = np.asarray(devices[:need]).reshape(
+        query_shards, db_hosts, db_shards)
+    return Mesh(grid, (QUERY_AXIS, HOST_AXIS, DB_AXIS))
+
+
+def is_hier(mesh: Mesh) -> bool:
+    """Whether ``mesh`` carries the cross-host axis (make_host_mesh)."""
+    return HOST_AXIS in mesh.shape
+
+
+def db_axes(mesh: Mesh):
+    """The db-sharding axis spec entry: the flat ``DB_AXIS`` or the
+    host-major ``(HOST_AXIS, DB_AXIS)`` pair on hierarchical meshes —
+    what every ``P(...)`` db spec and multi-axis collective uses."""
+    return (HOST_AXIS, DB_AXIS) if is_hier(mesh) else DB_AXIS
+
+
+def db_topology(mesh: Mesh) -> Tuple[int, int]:
+    """``(hosts, chips_per_host)`` of the db sharding; hosts == 1 on a
+    flat mesh.  Total db shards = hosts * chips."""
+    return mesh.shape.get(HOST_AXIS, 1), mesh.shape[DB_AXIS]
 
 
 def default_mesh(db_shards: int = 1) -> Mesh:

@@ -44,6 +44,7 @@ from knn_tpu.obs import names as _mn
 from knn_tpu.ops.normalize import local_minmax, minmax_apply
 from knn_tpu.ops.topk import knn_search_tiled, merge_topk, topk_pairs
 from knn_tpu.ops.vote import majority_vote
+from knn_tpu.parallel import crossover
 from knn_tpu.parallel.collectives import (
     allreduce_max,
     allreduce_min,
@@ -52,7 +53,14 @@ from knn_tpu.parallel.collectives import (
     shard,
     shard_map_compat,
 )
-from knn_tpu.parallel.mesh import DB_AXIS, QUERY_AXIS, pad_to_multiple
+from knn_tpu.parallel.mesh import (
+    DB_AXIS,
+    HOST_AXIS,
+    QUERY_AXIS,
+    db_axes,
+    db_topology,
+    pad_to_multiple,
+)
 
 _INT_SENTINEL = jnp.iinfo(jnp.int32).max
 
@@ -83,6 +91,42 @@ def _allgather_merge(d, i, k: int, axis_name: str):
     ad = jnp.moveaxis(ad, 0, 1).reshape(qs, -1)
     ai = jnp.moveaxis(ai, 0, 1).reshape(qs, -1)
     return topk_pairs(ad, ai, k)
+
+
+def _db_shard_index(hosts: int, chips: int):
+    """This device's GLOBAL db-shard index inside shard_map: the flat
+    db-axis position, or host-major ``host * chips + chip`` on a
+    hierarchical mesh — the row-block order ``P((HOST_AXIS, DB_AXIS))``
+    shards with."""
+    idx = lax.axis_index(DB_AXIS)
+    if hosts > 1:
+        idx = lax.axis_index(HOST_AXIS) * chips + idx
+    return idx
+
+
+def _merge_shards(d, gi, keep: int, hosts: int, chips: int,
+                  merge: str, dcn_merge: Optional[str]):
+    """The hierarchical top-k merge tree, inside shard_map: per-chip
+    candidate lists reduce per-host over the ICI db axis first (the
+    ``merge`` strategy), then per-host lists merge globally over the
+    DCN host axis (``dcn_merge``; strategies may differ — the measured
+    crossover picks each level by its own shard count).  Flat meshes
+    (hosts == 1) run the single-level merge unchanged.  The
+    lexicographic (distance, index) merge is associative + commutative
+    (ops.topk), so the two-level tree is bitwise-identical to the flat
+    merge — pinned in tests/test_multihost.py."""
+    if chips > 1:
+        if merge == "ring":
+            d, gi = _ring_merge(d, gi, keep, DB_AXIS, chips)
+        else:
+            d, gi = _allgather_merge(d, gi, keep, DB_AXIS)
+    if hosts > 1:
+        strat = dcn_merge or merge
+        if strat == "ring":
+            d, gi = _ring_merge(d, gi, keep, HOST_AXIS, hosts)
+        else:
+            d, gi = _allgather_merge(d, gi, keep, HOST_AXIS)
+    return d, gi
 
 
 def _pack_bits_u32(mask: jax.Array) -> jax.Array:
@@ -138,7 +182,9 @@ def _overlap_ratio(intervals) -> float:
     return overlapped / wall if wall > 0 else 0.0
 
 
-_MERGES = ("allgather", "ring")
+#: db-axis merge strategies — the canonical home is
+#: parallel.crossover.STRATEGIES (the measured-crossover module)
+_MERGES = crossover.STRATEGIES
 
 #: Certified-path coarse selectors.  "exact" ranks every row (float32
 #: lexicographic top-k); "approx" uses the hardware bin-reduction behind
@@ -149,7 +195,7 @@ SELECTORS = ("exact", "approx", "pallas")
 
 
 def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector,
-                recall_target=None):
+                recall_target=None, hosts=1, chips=1):
     """Local shard top-k with global train indices.
 
     The last db shard may contain zero-padding rows; their distances are
@@ -158,7 +204,7 @@ def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector,
     after its bin reduction — a pad row can then shadow one bin of the
     last shard, which the certified pipeline detects and repairs.
     """
-    db_idx = lax.axis_index(DB_AXIS)
+    db_idx = _db_shard_index(hosts, chips)
     n_local_valid = jnp.clip(n_train - db_idx * t.shape[0], 0, t.shape[0])
     if selector == "exact":
         d, i = knn_search_tiled(
@@ -180,16 +226,13 @@ def _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype, selector,
 
 
 def _merged_topk(q, t, k, metric, merge, n_train, train_tile, compute_dtype,
-                 db_shards, selector="exact", recall_target=None):
-    """Shared SPMD body: local shard top-k, then merge across the db axis."""
+                 hosts, chips, selector="exact", recall_target=None,
+                 dcn_merge=None):
+    """Shared SPMD body: local shard top-k, then the (hierarchical)
+    merge across the db sharding."""
     d, gi = _local_topk(q, t, k, metric, n_train, train_tile, compute_dtype,
-                        selector, recall_target)
-    if db_shards > 1:
-        if merge == "ring":
-            d, gi = _ring_merge(d, gi, k, DB_AXIS, db_shards)
-        else:
-            d, gi = _allgather_merge(d, gi, k, DB_AXIS)
-    return d, gi
+                        selector, recall_target, hosts, chips)
+    return _merge_shards(d, gi, k, hosts, chips, merge, dcn_merge)
 
 
 @functools.lru_cache(maxsize=64)
@@ -204,26 +247,73 @@ def _knn_program(
     selector: str = "exact",
     recall_target: Optional[float] = None,
     donate: bool = False,
+    dcn_merge: Optional[str] = None,
 ):
-    db_shards = mesh.shape[DB_AXIS]
+    hosts, chips = db_topology(mesh)
 
     def spmd(q, t):
         return _merged_topk(
             q, t, k, metric, merge, n_train, train_tile, compute_dtype,
-            db_shards, selector, recall_target,
+            hosts, chips, selector, recall_target, dcn_merge,
         )
 
     return jax.jit(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS)),
+            in_specs=(P(QUERY_AXIS), P(db_axes(mesh))),
             out_specs=(P(QUERY_AXIS), P(QUERY_AXIS)),
             check_vma=False,  # merged output is replicated along db by construction
         ),
         # the serving engine donates its per-request query placement so the
         # device buffer recycles instead of accumulating across a stream
         donate_argnums=(0,) if donate else (),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _hosttier_program(
+    mesh: Mesh,
+    k: int,
+    metric: str,
+    merge: str,
+    train_tile: Optional[int],
+    compute_dtype,
+    dcn_merge: Optional[str] = None,
+    donate: bool = False,
+):
+    """The per-sweep program of the host-RAM shard tier: one db SEGMENT
+    (streamed host->device this sweep) searched exactly like a resident
+    placement, except the valid-row count rides as a TRACED ``[1]``
+    operand — so the ragged tail segment pads to the same shape as
+    every full segment and all sweeps share ONE compiled executable
+    (the flat-per-sweep-latency contract).  ``donate=True`` donates the
+    segment buffer so HBM recycles sweep-over-sweep instead of
+    accumulating across the dispatch-ahead window; CPU XLA rejects
+    donation, so callers pass False there."""
+    hosts, chips = db_topology(mesh)
+
+    def spmd(q, t, n_valid):
+        db_idx = _db_shard_index(hosts, chips)
+        n_local = jnp.clip(n_valid[0] - db_idx * t.shape[0], 0, t.shape[0])
+        d, i = knn_search_tiled(
+            q, t, k, metric, train_tile=train_tile,
+            compute_dtype=compute_dtype, n_valid=n_local,
+        )
+        pad = i >= n_local
+        gi = jnp.where(pad, _INT_SENTINEL, i + db_idx * t.shape[0])
+        d = jnp.where(pad, jnp.inf, d)
+        return _merge_shards(d, gi, k, hosts, chips, merge, dcn_merge)
+
+    return jax.jit(
+        shard_map_compat(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(QUERY_AXIS), P(db_axes(mesh)), P()),
+            out_specs=(P(QUERY_AXIS), P(QUERY_AXIS)),
+            check_vma=False,
+        ),
+        donate_argnums=(1,) if donate else (),
     )
 
 
@@ -369,15 +459,36 @@ class ShardedKNN:
         mesh: Mesh,
         k: int,
         metric: str = "l2",
-        merge: str = "allgather",
+        merge: Optional[str] = None,
+        dcn_merge: Optional[str] = None,
         train_tile: Optional[int] = None,
         compute_dtype=None,
         labels=None,
         num_classes: Optional[int] = None,
         n_train: Optional[int] = None,
+        hbm_budget_bytes: Optional[int] = None,
     ):
-        if merge not in _MERGES:
-            raise ValueError(f"unknown merge {merge!r}; expected one of {_MERGES}")
+        # merge strategies resolve explicit > env (KNN_TPU_MERGE /
+        # KNN_TPU_DCN_MERGE) > the SCALING.json-measured crossover table
+        # (parallel.crossover) — results are bitwise-identical either
+        # way, so the default is free to chase the measured wall clock.
+        # On hierarchical meshes ``merge`` is the per-host ICI level and
+        # ``dcn_merge`` the cross-host level, each resolved by its own
+        # shard count.
+        hosts, chips = db_topology(mesh)
+        self.merge, self.merge_source = crossover.resolve_merge(
+            merge, k, chips)
+        self.dcn_merge, self.dcn_merge_source = (
+            crossover.resolve_merge(
+                dcn_merge, k, hosts, env_name=crossover.DCN_MERGE_ENV)
+            if hosts > 1 else (None, None))
+        obs.counter(_mn.MERGE_SELECTED, level="intra",
+                    strategy=self.merge, source=self.merge_source).inc()
+        if self.dcn_merge is not None:
+            obs.counter(_mn.MERGE_SELECTED, level="dcn",
+                        strategy=self.dcn_merge,
+                        source=self.dcn_merge_source).inc()
+        merge = self.merge
         # XLA compile events (count + seconds) from every program this
         # placement builds land in the registry; idempotent, no-op when
         # telemetry is off
@@ -398,11 +509,11 @@ class ShardedKNN:
         #: row norms + bound consts), cached per instance — "quantize
         #: once at placement time", the int8 arm's whole HBM story
         self._int8_cache = None
-        db_shards = mesh.shape[DB_AXIS]
+        db_shards = hosts * chips
         pre_placed = (
             isinstance(train, jax.Array)
             and train.sharding.is_equivalent_to(
-                NamedSharding(mesh, P(DB_AXIS)), train.ndim
+                NamedSharding(mesh, P(db_axes(mesh))), train.ndim
             )
         )
         if pre_placed:
@@ -447,7 +558,84 @@ class ShardedKNN:
             from knn_tpu.ops.pallas_knn import PAD_VAL
 
             tp, n_train = pad_to_multiple(train, db_shards, fill=PAD_VAL)
-        shard_rows = tp.shape[0] // db_shards
+        # --- host-RAM shard tier (the super-HBM escape hatch) ----------
+        # When the placement's per-host share exceeds the HBM budget
+        # (explicit arg > KNN_TPU_HOSTTIER_BUDGET_BYTES env > unbounded),
+        # the database stays in HOST memory partitioned into
+        # budget-sized segments (analysis.hbm.plan_segments); search()
+        # then streams the segments through the device placement
+        # sweep-by-sweep with dispatch-ahead overlap, merging each
+        # sweep's candidates into a running top-k carry.  Every segment
+        # pads to ONE shape, so all sweeps share one compiled program.
+        self._host_tier: Optional[dict] = None
+        budget = hbm_budget_bytes
+        if budget is None:
+            import os as _os
+
+            env_b = _os.environ.get(
+                "KNN_TPU_HOSTTIER_BUDGET_BYTES", "").strip()
+            if env_b:
+                try:
+                    budget = int(env_b)
+                except ValueError as e:
+                    raise ValueError(
+                        f"KNN_TPU_HOSTTIER_BUDGET_BYTES={env_b!r} is not "
+                        f"an int") from e
+        if budget is not None and budget <= 0:
+            raise ValueError(f"hbm_budget_bytes must be > 0, got {budget}")
+        if budget is not None and not isinstance(tp, np.ndarray):
+            # the tier streams from HOST memory; a pre-placed /
+            # device-resident array has no host rows to stream from.
+            # Refuse loudly when it would not fit rather than silently
+            # placing a super-budget corpus resident.
+            from knn_tpu.analysis import hbm
+
+            over = hbm.placement_bytes(
+                tp.shape[0], tp.shape[1],
+                int(jnp.dtype(tp.dtype).itemsize)) > budget * hosts
+            if over:
+                raise ValueError(
+                    f"hbm_budget_bytes={budget} per host cannot hold this "
+                    f"{tp.shape[0]}-row placement, and the host-RAM tier "
+                    f"needs a host-array construction to stream from; "
+                    f"pass the rows as a numpy array (or raise the budget)")
+        if budget is not None and isinstance(tp, np.ndarray):
+            from knn_tpu.analysis import hbm
+
+            itemsize = int(tp.dtype.itemsize)
+            total_b = hbm.placement_bytes(tp.shape[0], tp.shape[1], itemsize)
+            if total_b > budget * hosts:
+                import os as _os
+
+                env_d = _os.environ.get(
+                    "KNN_TPU_HOSTTIER_DEPTH", "").strip()
+                try:
+                    depth = int(env_d) if env_d else 2
+                except ValueError as e:
+                    # strict-env discipline (admission/merge switches):
+                    # a typo'd knob raises instead of silently running
+                    # at the default
+                    raise ValueError(
+                        f"KNN_TPU_HOSTTIER_DEPTH={env_d!r} is not an "
+                        f"int") from e
+                segments = hbm.plan_segments(
+                    n_train, tp.shape[1], budget, itemsize=itemsize,
+                    hosts=hosts, shard_multiple=db_shards)
+                seg_rows = segments[0][1] - segments[0][0]
+                self._host_tier = {
+                    "segments": segments,
+                    "segment_rows": seg_rows,
+                    "budget_bytes": int(budget),
+                    "bytes_per_sweep": hbm.placement_bytes(
+                        seg_rows, tp.shape[1], itemsize),
+                    "depth": max(1, depth),
+                    "itemsize": itemsize,
+                }
+                obs.gauge(_mn.HOSTTIER_SEGMENT_ROWS).set(float(seg_rows))
+        shard_rows = (
+            self._host_tier["segment_rows"] if self._host_tier is not None
+            else tp.shape[0]
+        ) // db_shards
         if k > shard_rows:
             raise ValueError(
                 f"k={k} exceeds db shard size {shard_rows}; use fewer db shards"
@@ -458,13 +646,18 @@ class ShardedKNN:
         self.k = k
         self.metric = metric
         self._db_norm_max_cache: Optional[float] = None
-        self.merge = merge
         self.train_tile = train_tile
         self.n_train = n_train
         self._dtype_key = (
             None if compute_dtype is None else jnp.dtype(compute_dtype).name
         )
-        self._tp = shard(tp, mesh, DB_AXIS)  # the reference's Scatter, once
+        if self._host_tier is not None:
+            self._tp = None  # segments stream per sweep; nothing resident
+            self._last_hosttier: Optional[dict] = None
+        else:
+            # the reference's Scatter, once (host-major over hosts x
+            # chips on hierarchical meshes)
+            self._tp = shard(tp, mesh, db_axes(mesh))
         #: (k, placed query rows) -> dispatch count: every distinct pair is
         #: one traced/compiled XLA program shape (compile_cache_stats)
         self._dispatch_shapes: dict = {}
@@ -490,6 +683,57 @@ class ShardedKNN:
                 )
             self._labels = replicate(labels, mesh)  # the reference's Bcast
 
+    @property
+    def db_shards(self) -> int:
+        """Total db shards: hosts x chips on hierarchical meshes."""
+        hosts, chips = db_topology(self.mesh)
+        return hosts * chips
+
+    def _shard_rows(self) -> int:
+        """Rows per db shard of the resident placement (or of one
+        host-tier segment)."""
+        if self._host_tier is not None:
+            return self._host_tier["segment_rows"] // self.db_shards
+        return self._tp.shape[0] // self.db_shards
+
+    def _require_resident(self, what: str) -> None:
+        """The paths that read the whole placed database (certified
+        pipeline, radius counts, votes, bucketed serving) need it
+        RESIDENT; the host-RAM tier only ever has one segment on
+        device."""
+        if self._tp is None:
+            raise ValueError(
+                f"{what} needs the full database resident on device, but "
+                f"this placement runs the host-RAM shard tier (corpus "
+                f"exceeds the {self._host_tier['budget_bytes']}-byte "
+                f"per-host HBM budget); use search(), or raise the budget")
+
+    def _record_merge_bytes(self, n_rows: int, k: int) -> None:
+        """Mirror the modeled per-level merge volume into the registry
+        (crossover.merge_bytes — the same model the roofline's DCN term
+        prices)."""
+        hosts, chips = db_topology(self.mesh)
+        if chips > 1:
+            obs.counter(_mn.MERGE_BYTES, level="intra",
+                        strategy=self.merge).inc(
+                crossover.merge_bytes(n_rows, k, chips, self.merge))
+        if hosts > 1 and self.dcn_merge is not None:
+            obs.counter(_mn.MERGE_BYTES, level="dcn",
+                        strategy=self.dcn_merge).inc(
+                crossover.merge_bytes(n_rows, k, hosts, self.dcn_merge))
+
+    def hosttier_stats(self) -> Optional[dict]:
+        """The host-RAM tier plan plus the last sweep's measurements
+        (sweeps, per-sweep walls, bytes/sweep); None when the placement
+        is fully resident."""
+        if self._host_tier is None:
+            return None
+        out = {k: v for k, v in self._host_tier.items() if k != "segments"}
+        out["sweeps"] = len(self._host_tier["segments"])
+        if self._last_hosttier is not None:
+            out["last_search"] = dict(self._last_hosttier)
+        return out
+
     def _place_queries(self, queries):
         if not isinstance(queries, jax.Array):
             queries = np.asarray(queries)
@@ -511,24 +755,130 @@ class ShardedKNN:
         returns true Euclidean values matching the reference / sklearn.
         """
         k = self.k if k is None else k
-        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        shard_rows = self._shard_rows()
         if k > min(self.n_train, shard_rows):
             raise ValueError(f"k={k} exceeds shard rows {shard_rows}")
+        if self._host_tier is not None:
+            return self._search_host_tier(queries, k, return_sqrt)
         qp, n_q = self._place_queries(queries)
         fn = _knn_program(
             self.mesh, k, self.metric, self.merge, self.n_train,
-            self.train_tile, self._dtype_key,
+            self.train_tile, self._dtype_key, dcn_merge=self.dcn_merge,
         )
         shape_key = (k, qp.shape[0])
         self._dispatch_shapes[shape_key] = (
             self._dispatch_shapes.get(shape_key, 0) + 1
         )
+        self._record_merge_bytes(qp.shape[0], k)
         d, i = _retry_transient(lambda: fn(qp, self._tp), "search dispatch")
         if return_sqrt:
             from knn_tpu.ops.distance import metric_values
 
             d = metric_values(d, self.metric)
         return d[:n_q], i[:n_q]
+
+    def _search_host_tier(self, queries, k: int, return_sqrt: bool):
+        """The host-RAM tier sweep: stream budget-sized db segments
+        host->device one per sweep (ALL sweeps share one compiled
+        program — the ragged tail pads to the same shape and masks via
+        the traced ``n_valid`` operand), with up to ``depth`` sweeps in
+        flight (the PR-1/PR-9 bounded-depth dispatch-ahead discipline:
+        drain the oldest before admitting a new one, so segment s+1's
+        h2d transfer and distance stream overlap segment s's fetch and
+        host merge).  Each fetched sweep's candidates merge into the
+        running top-k carry by the SAME lexicographic (distance, index)
+        order the device merge uses, so results are bitwise-identical
+        to the all-in-HBM placement (per-pair distances are
+        placement-invariant; tests/test_hosttier.py pins it).  Returns
+        host arrays — the carry lives on host by construction."""
+        import time as _time
+
+        from knn_tpu.ops.pallas_knn import PAD_VAL
+
+        ht = self._host_tier
+        host = self._train_host
+        seg_rows = ht["segment_rows"]
+        donate = jax.default_backend() != "cpu"
+        prog = _hosttier_program(
+            self.mesh, k, self.metric, self.merge, self.train_tile,
+            self._dtype_key, dcn_merge=self.dcn_merge, donate=donate)
+        qp, n_q = self._place_queries(queries)
+        shape_key = (k, qp.shape[0])
+        self._dispatch_shapes[shape_key] = (
+            self._dispatch_shapes.get(shape_key, 0) + 1
+        )
+
+        def launch(lo: int, hi: int):
+            seg = host[lo:hi]
+            if seg.shape[0] < seg_rows:
+                seg = np.pad(seg, ((0, seg_rows - seg.shape[0]), (0, 0)),
+                             constant_values=PAD_VAL)
+            tp = shard(seg, self.mesh, db_axes(self.mesh))
+            nv = replicate(np.asarray([hi - lo], np.int32), self.mesh)
+            return prog(qp, tp, nv)
+
+        best_d: Optional[np.ndarray] = None
+        best_i: Optional[np.ndarray] = None
+        pending: list = []
+        sweep_walls: list = []
+        t_wall0 = _time.perf_counter()
+
+        def collect() -> None:
+            nonlocal best_d, best_i
+            lo, hi, t0, out = pending.pop(0)
+            # d and i MUST come from the same execution: a transient
+            # fetch failure relaunches the sweep and rebinds BOTH
+            # outputs (a d from the relaunch paired with an i from the
+            # dead original would silently mis-rank)
+            cur = {"out": out}
+
+            def redo():
+                cur["out"] = launch(lo, hi)
+                return cur["out"][0]
+
+            d = _fetch_or_redispatch(out[0], redo, "host-tier fetch")
+            i = np.asarray(cur["out"][1])
+            sweep_walls.append(_time.perf_counter() - t0)
+            # globalize within-segment indices; sentinel rows stay put
+            pad = i == _INT_SENTINEL
+            gi = np.where(pad, _INT_SENTINEL, i.astype(np.int64) + lo)
+            self._record_merge_bytes(qp.shape[0], k)
+            obs.counter(_mn.HOSTTIER_SWEEPS).inc()
+            obs.histogram(_mn.HOSTTIER_SWEEP_SECONDS).observe(
+                sweep_walls[-1])
+            if best_d is None:
+                best_d, best_i = np.asarray(d), gi
+                return
+            # ONE home for the host-side lexicographic merge — the same
+            # order the device merge tree applies
+            from knn_tpu.parallel.multihost import merge_topk_host
+
+            best_d, best_i = merge_topk_host(
+                [best_d, np.asarray(d)], [best_i, gi], k)
+
+        for lo, hi in ht["segments"]:
+            while len(pending) >= ht["depth"]:
+                collect()
+            t0 = _time.perf_counter()
+            out = _retry_transient(lambda lo=lo, hi=hi: launch(lo, hi),
+                                   "host-tier dispatch")
+            pending.append((lo, hi, t0, out))
+        while pending:
+            collect()
+        self._last_hosttier = {
+            "sweeps": len(ht["segments"]),
+            "wall_s": round(_time.perf_counter() - t_wall0, 4),
+            "sweep_walls_s": [round(w, 4) for w in sweep_walls],
+            "k": k,
+            "queries": int(n_q),
+        }
+        d_out, i_out = best_d[:n_q], best_i[:n_q]
+        if return_sqrt:
+            from knn_tpu.ops.distance import metric_values
+
+            d_out = np.asarray(metric_values(jnp.asarray(d_out),
+                                             self.metric))
+        return d_out, i_out
 
     def search_bucketed(
         self, queries, *, buckets=None, min_bucket: int = 32,
@@ -547,6 +897,7 @@ class ShardedKNN:
         :meth:`compile_cache_stats` and :mod:`knn_tpu.serving` for the
         full serving surface (warmup, micro-batching queue, trace
         replay)."""
+        self._require_resident("search_bucketed")
         from knn_tpu.serving.buckets import normalize_ladder
         from knn_tpu.serving.engine import ServingEngine
 
@@ -630,6 +981,7 @@ class ShardedKNN:
         share one pairwise computation.  bf16 placements are refused outright —
         a bf16-ranked mask against an f32 count would widen the
         boundary band ~2000x."""
+        self._require_resident("radius_search")
         from knn_tpu.ops.radius import SENTINEL_IDX, radius_threshold
 
         if self._dtype_key is not None:
@@ -679,7 +1031,7 @@ class ShardedKNN:
                 f"sharded radius_search supports l2/cosine, not "
                 f"{self.metric!r}; use ops.radius.radius_search"
             )
-        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        shard_rows = self._shard_rows()
         m = min(int(max_neighbors), self.n_train)
         if m < 1:
             raise ValueError(f"max_neighbors must be >= 1, got {max_neighbors}")
@@ -905,6 +1257,7 @@ class ShardedKNN:
         """
         import os as _os
 
+        self._require_resident("search_certified")
         if overlap is None:
             # strict opt-in vocabulary, like serving.admission's env
             # knobs: anything else (off/no/typos) stays sequential
@@ -946,7 +1299,7 @@ class ShardedKNN:
         # the unit vectors placed at construction / normalized above)
         cert_metric = "l2" if self.metric == "cosine" else self.metric
         n_q = q_np.shape[0]
-        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        shard_rows = self._shard_rows()
         # margin is bounded by both the db size and the per-shard rows the
         # coarse/fallback programs select from (k itself fits: __init__
         # checks k <= shard_rows)
@@ -1009,6 +1362,7 @@ class ShardedKNN:
             exact = _knn_program(
                 self.mesh, widen, cert_metric, self.merge, self.n_train,
                 self.train_tile, None, "exact",
+                dcn_merge=self.dcn_merge,
             )
             bq, _ = self._place_queries(qb)
             fs, fi = exact(bq, self._tp)
@@ -1086,7 +1440,7 @@ class ShardedKNN:
         coarse = _knn_program(
             self.mesh, m, metric or self.metric, self.merge, self.n_train,
             self.train_tile, self._dtype_key, selector,
-            recall_target=recall_target,
+            recall_target=recall_target, dcn_merge=self.dcn_merge,
         )
         count_fn = _count_program(self.mesh, self.n_train, self.train_tile)
 
@@ -1197,7 +1551,7 @@ class ShardedKNN:
             quant_offset = self._int8_placement()["offset"]
 
         eff_bin = bin_w or BIN_W
-        shard_rows = self._tp.shape[0] // self.mesh.shape[DB_AXIS]
+        shard_rows = self._shard_rows()
         # same tile the kernel will pick (ONE home for the arithmetic:
         # ops.pallas_knn.effective_tile), so the m-cap below matches the
         # kernel's real candidate width
@@ -1242,6 +1596,7 @@ class ShardedKNN:
                 final_recall_target=final_recall_target,
                 quant_offset=quant_offset,
                 donate=_jax.default_backend() != "cpu",
+                dcn_merge=self.dcn_merge,
             )
             return (coarse, tail), m, _analysis_window(self.k, m)
         prog = _pallas_certified_program(
@@ -1251,7 +1606,7 @@ class ShardedKNN:
             include_distances=include_distances, binning=binning,
             final_recall_target=final_recall_target,
             grid_order=grid_order, kernel=kernel,
-            quant_offset=quant_offset,
+            quant_offset=quant_offset, dcn_merge=self.dcn_merge,
         )
         return prog, m, _analysis_window(self.k, m)
 
@@ -1427,10 +1782,12 @@ class ShardedKNN:
         """Predicted labels [Q] — requires ``labels`` at construction."""
         if self._labels is None:
             raise RuntimeError("ShardedKNN built without labels; predict unavailable")
+        self._require_resident("predict")
         qp, n_q = self._place_queries(queries)
         fn = _predict_program(
             self.mesh, self.k, self.num_classes, self.metric, self.merge,
             self.n_train, self.train_tile, self._dtype_key,
+            dcn_merge=self.dcn_merge,
         )
         out = _retry_transient(lambda: fn(qp, self._tp, self._labels),
                                "predict dispatch")
@@ -1444,7 +1801,7 @@ def sharded_knn(
     *,
     mesh: Mesh,
     metric: str = "l2",
-    merge: str = "allgather",
+    merge: Optional[str] = None,
     train_tile: Optional[int] = None,
     compute_dtype=None,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -1474,18 +1831,20 @@ def _predict_program(
     train_tile: Optional[int],
     compute_dtype,
     donate: bool = False,
+    dcn_merge: Optional[str] = None,
 ):
-    db_shards = mesh.shape[DB_AXIS]
+    hosts, chips = db_topology(mesh)
 
     def spmd(q, t):
         return _merged_topk(
-            q, t, k, metric, merge, n_train, train_tile, compute_dtype, db_shards
+            q, t, k, metric, merge, n_train, train_tile, compute_dtype,
+            hosts, chips, dcn_merge=dcn_merge,
         )
 
     knn = shard_map_compat(
         spmd,
         mesh=mesh,
-        in_specs=(P(QUERY_AXIS), P(DB_AXIS)),
+        in_specs=(P(QUERY_AXIS), P(db_axes(mesh))),
         out_specs=(P(QUERY_AXIS), P(QUERY_AXIS)),
         check_vma=False,
     )
@@ -1514,7 +1873,7 @@ def sharded_knn_predict(
     num_classes: int,
     mesh: Mesh,
     metric: str = "l2",
-    merge: str = "allgather",
+    merge: Optional[str] = None,
     train_tile: Optional[int] = None,
     compute_dtype=None,
 ) -> jax.Array:
@@ -1542,6 +1901,7 @@ def _pallas_certified_program(
     grid_order: str = "query_major",
     kernel: str = "tiled",
     quant_offset: float = 0.0,
+    dcn_merge: Optional[str] = None,
 ):
     """ONE-pass sharded self-certifying coarse select + device rank +
     device certificate (ops.pallas_knn.local_certified_candidates per
@@ -1590,7 +1950,7 @@ def _pallas_certified_program(
         local_certified_candidates,
     )
 
-    db_shards = mesh.shape[DB_AXIS]
+    hosts, chips = db_topology(mesh)
     eff_tile = tile_n or TILE_N
     eff_bin = bin_w or BIN_W
     eff_bq = block_q or BLOCK_Q
@@ -1609,7 +1969,8 @@ def _pallas_certified_program(
         return _certify_pack_spmd(
             q, t, d32, li, lb, consts=consts, db_norm_max=db_norm_max,
             precision=precision, quant_offset=quant_offset, m=m, k=k, w=w,
-            merge=merge, n_train=n_train, db_shards=db_shards,
+            merge=merge, n_train=n_train, hosts=hosts, chips=chips,
+            dcn_merge=dcn_merge,
             include_distances=include_distances,
         )
 
@@ -1617,19 +1978,20 @@ def _pallas_certified_program(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS), *_tail_specs(int8)),
+            in_specs=(P(QUERY_AXIS), P(db_axes(mesh)), *_tail_specs(int8, mesh)),
             out_specs=P(QUERY_AXIS),
             check_vma=False,
         )
     )
 
 
-def _tail_specs(int8: bool):
+def _tail_specs(int8: bool, mesh: Mesh):
     """shard_map in_specs of the precision-shaped operand tail
     (ShardedKNN._pallas_operands): int8 = the quantized placement
     (db-sharded values/scales/norms + replicated bound consts), f32 =
     the replicated scalar db-norm bound."""
-    return (P(DB_AXIS), P(DB_AXIS), P(DB_AXIS), P()) if int8 else (P(),)
+    dbp = db_axes(mesh)
+    return (P(dbp), P(dbp), P(dbp), P()) if int8 else (P(),)
 
 
 def _split_operand_tail(int8: bool, tail):
@@ -1644,7 +2006,8 @@ def _split_operand_tail(int8: bool, tail):
 
 def _certify_pack_spmd(q, t, d32, li, lb, *, consts, db_norm_max,
                        precision, quant_offset, m, k, w, merge, n_train,
-                       db_shards, include_distances):
+                       hosts, chips, include_distances,
+                       dcn_merge=None):
     """The certify/pack tail of the pallas certified program, from one
     shard's ranked candidates ``(d32, li, lb)`` to the packed host-facing
     int32 array — ONE home shared by the one-shot program
@@ -1656,7 +2019,8 @@ def _certify_pack_spmd(q, t, d32, li, lb, *, consts, db_norm_max,
     from knn_tpu.ops.pallas_knn import RANK_SLACK
 
     int8 = precision == "int8"
-    db_idx = lax.axis_index(DB_AXIS)
+    db_shards = hosts * chips
+    db_idx = _db_shard_index(hosts, chips)
     gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,
                    li + db_idx * t.shape[0])
     if n_train is not None:
@@ -1668,11 +2032,14 @@ def _certify_pack_spmd(q, t, d32, li, lb, *, consts, db_norm_max,
         gi = jnp.where(pad, _INT_SENTINEL, gi)
         d32 = jnp.where(pad, jnp.inf, d32)
     if db_shards > 1:
-        if merge == "ring":
-            d32, gi = _ring_merge(d32, gi, m + 1, DB_AXIS, db_shards)
-        else:
-            d32, gi = _allgather_merge(d32, gi, m + 1, DB_AXIS)
-        lb = lax.pmin(lb, axis_name=DB_AXIS)
+        # hierarchical merge tree: per-chip -> per-host over ICI, then
+        # per-host -> global over DCN; the exclusion bound pmins over
+        # every db-sharding axis in one reduction
+        d32, gi = _merge_shards(d32, gi, m + 1, hosts, chips, merge,
+                                dcn_merge)
+        lb = lax.pmin(
+            lb,
+            axis_name=(HOST_AXIS, DB_AXIS) if hosts > 1 else DB_AXIS)
 
     # --- device rank analysis over the window [0, w) ---------------
     dw = d32[:, :w]
@@ -1751,6 +2118,7 @@ def _pallas_coarse_program(
     )
 
     int8 = precision == "int8"
+    dbp = db_axes(mesh)
 
     def spmd(q, t, *tail):
         db_int8, _, _ = _split_operand_tail(int8, tail)
@@ -1766,9 +2134,9 @@ def _pallas_coarse_program(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS), *_tail_specs(int8)),
-            out_specs=(P(QUERY_AXIS, DB_AXIS), P(QUERY_AXIS, DB_AXIS),
-                       P(QUERY_AXIS, DB_AXIS)),
+            in_specs=(P(QUERY_AXIS), P(dbp), *_tail_specs(int8, mesh)),
+            out_specs=(P(QUERY_AXIS, dbp), P(QUERY_AXIS, dbp),
+                       P(QUERY_AXIS, dbp)),
             check_vma=False,
         )
     )
@@ -1781,6 +2149,7 @@ def _pallas_tail_program(
     include_distances: bool = True,
     final_recall_target: Optional[float] = None,
     quant_offset: float = 0.0, donate: bool = False,
+    dcn_merge: Optional[str] = None,
 ):
     """Stage 2 of the two-stage certified pipeline: final select +
     rescore gather (ops.pallas_knn.local_select_rescore) + the shared
@@ -1791,7 +2160,8 @@ def _pallas_tail_program(
     donation, so callers pass False there."""
     from knn_tpu.ops.pallas_knn import local_select_rescore
 
-    db_shards = mesh.shape[DB_AXIS]
+    hosts, chips = db_topology(mesh)
+    dbp = db_axes(mesh)
     w = _analysis_window(k, m)
     int8 = precision == "int8"
 
@@ -1804,7 +2174,8 @@ def _pallas_tail_program(
         return _certify_pack_spmd(
             q, t, d32, li, lb, consts=consts, db_norm_max=db_norm_max,
             precision=precision, quant_offset=quant_offset, m=m, k=k, w=w,
-            merge=merge, n_train=n_train, db_shards=db_shards,
+            merge=merge, n_train=n_train, hosts=hosts, chips=chips,
+            dcn_merge=dcn_merge,
             include_distances=include_distances,
         )
 
@@ -1812,9 +2183,9 @@ def _pallas_tail_program(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P(QUERY_AXIS, DB_AXIS),
-                      P(QUERY_AXIS, DB_AXIS), P(QUERY_AXIS, DB_AXIS),
-                      *_tail_specs(int8)),
+            in_specs=(P(QUERY_AXIS), P(dbp), P(QUERY_AXIS, dbp),
+                      P(QUERY_AXIS, dbp), P(QUERY_AXIS, dbp),
+                      *_tail_specs(int8, mesh)),
             out_specs=P(QUERY_AXIS),
             check_vma=False,
         ),
@@ -1849,26 +2220,27 @@ def _count_program(mesh: Mesh, n_train: int, train_tile: Optional[int]):
     axis; output replicated there."""
     from knn_tpu.ops.certified import count_below
 
-    db_shards = mesh.shape[DB_AXIS]
+    hosts, chips = db_topology(mesh)
+    dbp = db_axes(mesh)
     tile = train_tile or 131072
 
     def spmd(q, t, thr):
-        db_idx = lax.axis_index(DB_AXIS)
+        db_idx = _db_shard_index(hosts, chips)
         n_local_valid = jnp.clip(n_train - db_idx * t.shape[0], 0, t.shape[0])
         # count within the local shard, masking padding rows via a
         # +inf-threshold trick: rows >= n_local_valid can't be < thr
         local = count_below.__wrapped__(
             t, q, thr, tile=min(tile, t.shape[0]), n_valid=n_local_valid
         )
-        if db_shards > 1:
-            local = lax.psum(local, DB_AXIS)
+        if hosts * chips > 1:
+            local = lax.psum(local, dbp if hosts > 1 else DB_AXIS)
         return local
 
     return jax.jit(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(DB_AXIS), P(QUERY_AXIS)),
+            in_specs=(P(QUERY_AXIS), P(dbp), P(QUERY_AXIS)),
             out_specs=P(QUERY_AXIS),
             check_vma=False,
         )
@@ -1877,6 +2249,9 @@ def _count_program(mesh: Mesh, n_train: int, train_tile: Optional[int]):
 
 @functools.lru_cache(maxsize=16)
 def _minmax_program(mesh: Mesh, n_arrays: int):
+    axes = (QUERY_AXIS, HOST_AXIS, DB_AXIS) if HOST_AXIS in mesh.shape \
+        else (QUERY_AXIS, DB_AXIS)
+
     def spmd(*arrays):
         lo, hi = None, None
         for a in arrays:
@@ -1884,15 +2259,15 @@ def _minmax_program(mesh: Mesh, n_arrays: int):
             lo = alo if lo is None else jnp.minimum(lo, alo)
             hi = ahi if hi is None else jnp.maximum(hi, ahi)
         # The reference's two Allreduces, knn_mpi.cpp:276-277:
-        lo = allreduce_min(lo, (QUERY_AXIS, DB_AXIS))
-        hi = allreduce_max(hi, (QUERY_AXIS, DB_AXIS))
+        lo = allreduce_min(lo, axes)
+        hi = allreduce_max(hi, axes)
         return lo, hi
 
     return jax.jit(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=tuple(P((QUERY_AXIS, DB_AXIS)) for _ in range(n_arrays)),
+            in_specs=tuple(P(axes) for _ in range(n_arrays)),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -1925,7 +2300,10 @@ def sharded_minmax(
         if target != n:
             pad_fn = np.pad if isinstance(a, np.ndarray) else jnp.pad
             a = pad_fn(a, ((0, target - n), (0, 0)), mode="edge")
-        padded.append(shard(a, mesh, (QUERY_AXIS, DB_AXIS)))
+        padded.append(shard(
+            a, mesh,
+            (QUERY_AXIS, HOST_AXIS, DB_AXIS) if HOST_AXIS in mesh.shape
+            else (QUERY_AXIS, DB_AXIS)))
     fn = _minmax_program(mesh, len(padded))
     return fn(*padded)
 

@@ -201,6 +201,11 @@ class ServingEngine:
             donate_queries = jax.default_backend() != "cpu"
         self.donate_queries = bool(donate_queries)
         self._aot = bool(aot)
+        if getattr(program, "_tp", None) is None:
+            # a host-RAM-tier placement has no resident database to
+            # AOT-compile against — refuse with the tier's own message
+            # instead of a cryptic NoneType AttributeError below
+            program._require_resident("ServingEngine")
         self._dim = int(program._tp.shape[1])
         self._lock = threading.Lock()
         self._execs: Dict[Tuple[str, int], object] = {}
@@ -240,6 +245,7 @@ class ServingEngine:
             return _knn_program(
                 p.mesh, self.k, p.metric, p.merge, p.n_train, p.train_tile,
                 p._dtype_key, donate=self.donate_queries,
+                dcn_merge=p.dcn_merge,
             )
         if p._labels is None:
             raise RuntimeError(
@@ -248,6 +254,7 @@ class ServingEngine:
         return _predict_program(
             p.mesh, self.k, p.num_classes, p.metric, p.merge, p.n_train,
             p.train_tile, p._dtype_key, donate=self.donate_queries,
+            dcn_merge=p.dcn_merge,
         )
 
     def _placed_rows(self, bucket: int) -> int:

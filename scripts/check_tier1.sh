@@ -24,6 +24,17 @@
 # `regress` verdict on the newest curated bench round against its
 # history fails the run (docs/OBSERVABILITY.md "Regression sentinel").
 cd "$(dirname "$0")/.." || exit 1
+if [ "${1:-}" = "--multihost" ]; then
+  # The real multi-process lane: every tests/test_multihost.py test,
+  # including the 2-process CPU jax.distributed subprocess harness
+  # (tests/mh_harness.py — per-host local compute + coordinator-KV DCN
+  # merge, a pinned lane on every supported jaxlib) and the
+  # collective-gated tests that skip ONLY when the harness's own
+  # capability probe is red (-rs prints each skip's probed reason).
+  exec env JAX_PLATFORMS=cpu python -m pytest tests/test_multihost.py \
+    tests/test_hosttier.py \
+    -q -rs -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+fi
 if [ "${1:-}" = "--fast" ]; then
   python -m knn_tpu.cli lint || exit 1  # the full static-analysis suite
   python scripts/perf_sentinel.py --lint || exit 1
@@ -36,6 +47,7 @@ if [ "${1:-}" = "--fast" ]; then
     tests/test_calibrate.py \
     tests/test_loadgen.py tests/test_admission.py \
     tests/test_waterfall.py \
+    tests/test_multihost.py tests/test_hosttier.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "${1:-}" = "--strict" ]; then

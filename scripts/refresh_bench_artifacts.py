@@ -271,6 +271,39 @@ except Exception as _e:  # noqa: BLE001 — curation must never fail on it
     print(f"knee curation skipped: {type(_e).__name__}: {_e}",
           file=sys.stderr)
 
+# multihost curation (knn_tpu.parallel.crossover): a fresh line
+# carrying a `multihost` block (bench's multihost mode — hierarchical
+# merge + host-RAM tier) is validated — malformed blocks REFUSED, the
+# roofline/knee discipline — with the merge strategy, host count, and
+# host-tier sweep count hoisted top-level for the curated summary.
+try:
+    from knn_tpu.parallel.crossover import (
+        validate_multihost_block as _vmh,
+    )
+
+    for cfg, rec in best.items():
+        if rec["stale"]:
+            continue  # a republished number keeps its old block verbatim
+        block = rec.get("multihost")
+        if block is None:
+            continue
+        errs = _vmh(block)
+        if errs:
+            sys.exit(f"refusing to emit curated line for {cfg}: "
+                     f"malformed multihost block: {'; '.join(errs)}")
+        rec.setdefault("multihost_hosts", block["hosts"])
+        dcn = (block.get("merge") or {}).get("dcn") or {}
+        if dcn.get("strategy"):
+            rec.setdefault("multihost_merge", dcn["strategy"])
+        ht = block.get("hosttier") or {}
+        if ht.get("sweeps"):
+            rec.setdefault("hosttier_sweeps", ht["sweeps"])
+except SystemExit:
+    raise
+except Exception as _e:  # noqa: BLE001 — curation must never fail on it
+    print(f"multihost curation skipped: {type(_e).__name__}: {_e}",
+          file=sys.stderr)
+
 # perf-regression sentinel (knn_tpu.obs.sentinel): every curated line
 # carries its verdict against the robust baseline of STRICTLY EARLIER
 # rounds (a line never seeds the baseline it is judged against); stale
@@ -321,4 +354,12 @@ with open(DST, "w") as f:
               # session ran one: max SLO-meeting sustained request rate
               + (f" knee={r['knee_qps']}q/s"
                  if isinstance(r.get("knee_qps"), (int, float)) else "")
+              # the multi-host topology measurement, when the session
+              # ran one: host count x DCN merge strategy + host-RAM
+              # tier sweep count
+              + (f" multihost={r['multihost_hosts']}x"
+                 f"{r.get('multihost_merge')}"
+                 + (f"/{r['hosttier_sweeps']}sweeps"
+                    if isinstance(r.get("hosttier_sweeps"), int) else "")
+                 if isinstance(r.get("multihost_hosts"), int) else "")
               + (" STALE" if r["stale"] else ""))

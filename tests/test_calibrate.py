@@ -336,7 +336,7 @@ def test_estimated_flag_semantics_preserved_under_calibration(
 
 def test_r05_curated_line_rerenders_with_explicit_calibration_absent():
     """ACCEPTANCE pin: the r05 SIFT1M curated line back-derives to a
-    MODEL_VERSION-3 block whose calibration verdict is EXPLICITLY
+    current-MODEL_VERSION block whose calibration verdict is EXPLICITLY
     absent — pre-calibration history re-renders honestly instead of
     silently claiming calibrated."""
     rec = None
@@ -347,7 +347,7 @@ def test_r05_curated_line_rerenders_with_explicit_calibration_absent():
             break
     assert rec is not None
     block = roofline.block_for_bench_line(rec)
-    assert block["model_version"] == 3
+    assert block["model_version"] == roofline.MODEL_VERSION
     assert block["calibration"] == {"applied": False}
     assert block["ceiling_qps"] == block["ceiling_qps_analytic"]
     assert roofline.validate_block(block) == []

@@ -105,3 +105,118 @@ def test_barrier_blocks_on_device_values(rng):
     y = jax.jit(lambda a: a * 2)(x)
     barrier(y, [x, {"k": y}], None, 3.0)  # arbitrary trees + non-arrays ok
     assert np.asarray(y).shape == (8, 2)
+
+
+# --- measured ring/allgather crossover (parallel.crossover) -------------
+
+def _scaling_rows():
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SCALING.json")
+    return json.load(open(path))["rows"]
+
+
+def test_crossover_table_matches_scaling_json():
+    """The persisted MEASURED_CROSSOVER table must be the argmin-wall
+    strategy at every measured SCALING.json (k, shards) point — edit
+    the measurement and this pin forces the table to follow."""
+    from knn_tpu.parallel import crossover
+
+    best = {}
+    for row in _scaling_rows():
+        if row["merge"] == "none":
+            continue
+        shards = int(row["mesh"].split("x")[1])
+        key = (row["k"], shards)
+        if key not in best or row["wall_s"] < best[key][1]:
+            best[key] = (row["merge"], row["wall_s"])
+    derived = {k: v[0] for k, v in best.items()}
+    assert derived == crossover.MEASURED_CROSSOVER
+
+
+def test_merge_bytes_model_reproduces_scaling_column():
+    """merge_bytes must reproduce SCALING.json's measured
+    merge_bytes_per_sweep column exactly (Q=2048 queries per sweep)."""
+    from knn_tpu.parallel import crossover
+
+    for row in _scaling_rows():
+        if row["merge"] == "none":
+            continue
+        shards = int(row["mesh"].split("x")[1])
+        assert crossover.merge_bytes(2048, row["k"], shards,
+                                     row["merge"]) == \
+            row["merge_bytes_per_sweep"], row
+
+
+def test_choose_merge_nearest_point_and_trivial_shards():
+    from knn_tpu.parallel import crossover
+
+    # measured points verbatim
+    assert crossover.choose_merge(10, 4) == "ring"
+    assert crossover.choose_merge(100, 2) == "ring"
+    assert crossover.choose_merge(100, 8) == "allgather"
+    # nearest-in-log lookups off the grid
+    # 3 shards sits nearer 4 than 2 in log space
+    assert crossover.choose_merge(12, 3) == \
+        crossover.MEASURED_CROSSOVER[(10, 4)]
+    assert crossover.choose_merge(1000, 16) == \
+        crossover.MEASURED_CROSSOVER[(100, 8)]
+    assert crossover.choose_merge(5, 1) == "allgather"  # no merge at all
+
+
+def test_sharded_default_merge_follows_measured_table(rng):
+    """REGRESSION (ISSUE 12 satellite): ShardedKNN's default merge is
+    no longer caller folklore — merge=None resolves to the measured
+    crossover per (k, db_shards), an env switch overrides the table,
+    and an explicit argument still beats both."""
+    import os
+
+    from knn_tpu.parallel import ShardedKNN, crossover
+
+    db = rng.normal(size=(512, 6)).astype(np.float32)
+    for k, shards in ((10, 2), (100, 4), (7, 8)):
+        mesh = make_mesh(8 // shards, shards)
+        prog = ShardedKNN(db, mesh=mesh, k=k)
+        assert prog.merge == crossover.choose_merge(k, shards)
+        assert prog.merge_source == "measured"
+    db = rng.normal(size=(64, 6)).astype(np.float32)
+    # env beats the table ...
+    os.environ["KNN_TPU_MERGE"] = "ring"
+    try:
+        prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=10)
+        assert (prog.merge, prog.merge_source) == ("ring", "env")
+        # ... and an explicit argument beats the env
+        prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=10,
+                          merge="allgather")
+        assert (prog.merge, prog.merge_source) == ("allgather", "explicit")
+    finally:
+        os.environ.pop("KNN_TPU_MERGE", None)
+    # malformed env values raise instead of silently steering
+    os.environ["KNN_TPU_MERGE"] = "bogus"
+    try:
+        import pytest
+
+        with pytest.raises(ValueError, match="KNN_TPU_MERGE"):
+            ShardedKNN(db, mesh=make_mesh(4, 2), k=10)
+    finally:
+        os.environ.pop("KNN_TPU_MERGE", None)
+
+
+def test_validate_multihost_block_contract():
+    from knn_tpu.parallel.crossover import validate_multihost_block
+
+    good = {"hosts": 2, "chips_per_host": 2,
+            "merge": {"intra": {"strategy": "allgather",
+                                "source": "measured"},
+                      "dcn": {"strategy": "ring", "source": "env"}},
+            "dcn_merge_bytes": 1024,
+            "hosttier": {"sweeps": 3, "budget_bytes": 4096,
+                         "segment_rows": 64}}
+    assert validate_multihost_block(good) == []
+    assert validate_multihost_block("nope")
+    assert validate_multihost_block({"hosts": 0, "merge": {}})
+    bad = dict(good, hosttier={"sweeps": 0, "budget_bytes": -1,
+                               "segment_rows": None})
+    assert len(validate_multihost_block(bad)) == 3

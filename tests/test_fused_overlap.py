@@ -323,9 +323,10 @@ def test_roofline_v2_select_overlap_semantics():
     assert m8f["ceiling_qps"] == pytest.approx(
         4096 / max(t.values()), rel=1e-3)
     # v3 = the calibrated model (tests/test_calibrate.py owns the
-    # overlay semantics); the select-overlap formulas above are pinned
-    # version-independently
-    assert roofline.MODEL_VERSION == 3
+    # overlay semantics); v4 = the multi-host DCN merge term
+    # (tests/test_multihost.py/test_roofline.py own it); the
+    # select-overlap formulas above are pinned version-independently
+    assert roofline.MODEL_VERSION == 4
     # a fused config whose carry would exceed MAX_CARRY_DEPTH disarms
     # in the kernel — the model mirrors the disarm and falls back to
     # the serialized ceiling, so pruning/--best can never hold other
@@ -336,9 +337,9 @@ def test_roofline_v2_select_overlap_semantics():
     assert deep["ceiling_qps"] == roofline.pallas_cost_model(
         precision="int8", kernel="streaming",
         **{**base, "k": 1024})["ceiling_qps"]
-    # the cache token follows the model version: pre-v3 entries miss
+    # the cache token follows the model version: pre-bump entries miss
     key = tuning.cache_key("cpu", 700, 16, 5, "l2", None)
-    assert "|rl3|" in key
+    assert f"|rl{roofline.MODEL_VERSION}|" in key
     assert roofline.validate_block(
         roofline.attribute(m8f, 100.0)) == []
     with pytest.raises(ValueError, match="kernel"):

@@ -369,3 +369,44 @@ def test_malformed_knee_block_refused(tmp_path):
     assert r.returncode != 0
     assert "malformed loadgen_knee block" in (r.stderr + r.stdout)
     assert not (tmp_path / "TPU_BENCH_r09.jsonl").exists()
+
+
+def test_multihost_block_curated_and_printed(tmp_path):
+    # a fresh line carrying a multihost block (bench multihost mode —
+    # hierarchical merge + host-RAM tier) gets hosts / dcn strategy /
+    # sweep count hoisted top-level and the per-line print shows
+    # multihost= beside the sentinel verdict
+    block = {"hosts": 2, "chips_per_host": 2,
+             "merge": {"intra": {"strategy": "allgather",
+                                 "source": "measured"},
+                       "dcn": {"strategy": "ring",
+                               "source": "measured"}},
+             "dcn_merge_bytes": 2560,
+             "hosttier": {"sweeps": 4, "budget_bytes": 17408,
+                          "segment_rows": 512}}
+    rec = dict(_line(120.0, gate=True, cfg="knn_qps_multihost"),
+               multihost=block)
+    r = _run_with_repo(tmp_path, 9, [rec])
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "TPU_BENCH_r09.jsonl").read_text().splitlines()]
+    (row,) = rows
+    assert row["multihost_hosts"] == 2
+    assert row["multihost_merge"] == "ring"
+    assert row["hosttier_sweeps"] == 4
+    assert row["multihost"] == block
+    assert "multihost=2xring/4sweeps" in r.stdout
+
+
+def test_malformed_multihost_block_refused(tmp_path):
+    # a corrupt multihost block would silently poison the curated
+    # summary — the refresher must refuse the round (same discipline
+    # as roofline/knee/calibration blocks)
+    bad = dict(_line(120.0, gate=True),
+               multihost={"hosts": 0,
+                          "merge": {"dcn": {"strategy": "bogus",
+                                            "source": "vibes"}}})
+    r = _run_with_repo(tmp_path, 9, [bad])
+    assert r.returncode != 0
+    assert "malformed multihost block" in (r.stderr + r.stdout)
+    assert not (tmp_path / "TPU_BENCH_r09.jsonl").exists()

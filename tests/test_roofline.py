@@ -456,3 +456,58 @@ def test_cli_roofline_subcommand(capsys):
     assert rc == 0
     block = json.loads(capsys.readouterr().out)
     assert roofline.validate_block(block) == []
+
+
+# --- MODEL_VERSION 4: the multi-host DCN merge term ---------------------
+
+def test_dcn_term_only_on_multihost_blocks():
+    base = dict(n=1_000_000, d=128, k=10, nq=4096,
+                device_kind="TPU v5e", backend="tpu", num_devices=8)
+    single = roofline.pallas_cost_model(precision="int8", **base)
+    multi = roofline.pallas_cost_model(precision="int8", db_hosts=4,
+                                       dcn_merge="ring", **base)
+    assert "dcn" not in single["terms"]
+    dcn = multi["terms"]["dcn"]
+    from knn_tpu.parallel.crossover import merge_bytes
+
+    assert dcn["bytes"] == merge_bytes(4096, 10, 4, "ring")
+    assert dcn["hosts"] == 4 and dcn["strategy"] == "ring"
+    # the DCN merge serializes after compute: ceiling strictly drops
+    assert multi["ceiling_qps"] < single["ceiling_qps"]
+    # recompute the combined-time formula from the block's own terms
+    # (tiled kernel: select serialized, then the DCN merge after it)
+    t = multi["term_times_s"]
+    assert multi["select_overlapped"] is False
+    expect = 4096 / (max(t["hbm_bound"], t["mxu_bound"])
+                     + t["vpu_select_bound"] + t["dcn_bound"])
+    assert multi["ceiling_qps"] == pytest.approx(expect, rel=1e-3)
+    assert roofline.validate_block(multi) == []
+
+
+def test_dcn_bound_class_and_strategy_default():
+    # a pathologically slow DCN makes the merge the binding resource
+    peaks = dict(roofline.PEAKS_BY_KIND["TPU v5e"], dcn_gbps=1e-6)
+    m = roofline.xla_cost_model(n=100_000, d=64, k=100, nq=2048,
+                                selector="exact", db_hosts=8,
+                                peaks=peaks)
+    assert m["bound_class"] == "dcn_bound"
+    # dcn_merge=None resolves through the measured crossover table
+    from knn_tpu.parallel.crossover import choose_merge
+
+    assert m["terms"]["dcn"]["strategy"] == choose_merge(100, 8)
+    # multihost blocks carry an explicitly-absent calibration verdict
+    assert m["calibration"]["applied"] is False
+    assert "dcn" in roofline.render_text(m)
+
+
+def test_validate_block_rejects_malformed_dcn_term():
+    m = roofline.pallas_cost_model(
+        n=1_000_000, d=128, k=10, nq=4096, precision="int8",
+        device_kind="TPU v5e", backend="tpu", db_hosts=2)
+    assert roofline.validate_block(m) == []
+    bad = {**m, "terms": {**m["terms"],
+                          "dcn": {**m["terms"]["dcn"], "hosts": 1,
+                                  "strategy": "bogus"}}}
+    errs = roofline.validate_block(bad)
+    assert any("terms.dcn.hosts" in e for e in errs)
+    assert any("terms.dcn.strategy" in e for e in errs)
