@@ -40,7 +40,7 @@ honest denominator.
 Env overrides:
   KNN_BENCH_CONFIG   sift1m (default) | glove | gist1m   (BASELINE configs 3/4/5)
   KNN_BENCH_MODES    comma list from {exact,certified_approx,
-                     certified_pallas,serving,knee,multihost}
+                     certified_pallas,serving,knee,multihost,mutation}
   KNN_BENCH_RUNS     timed repetitions per mode (default 5)
   KNN_BENCH_N, KNN_BENCH_DIM, KNN_BENCH_K, KNN_BENCH_NQ, KNN_BENCH_BATCH,
   KNN_BENCH_TILE, KNN_BENCH_CPU_QUERIES, KNN_BENCH_MARGIN,
@@ -196,6 +196,17 @@ try:
     #: tier).  Opt-in via KNN_BENCH_MODES=..,multihost
     MULTIHOST_HOSTS = _env_int("KNN_BENCH_MULTIHOST_HOSTS", 2)
     MULTIHOST_SWEEPS = _env_int("KNN_BENCH_MULTIHOST_SWEEPS", 4)
+
+    #: ``mutation`` mode (knn_tpu.index + knn_tpu.loadgen): live mixed
+    #: read+write traffic against a MutableIndex-backed serving stack
+    #: across background compaction swaps.  Opt-in via
+    #: KNN_BENCH_MODES=..,mutation (docs/INDEX.md)
+    MUTATION_RATE = float(os.environ.get(
+        "KNN_BENCH_MUTATION_RATE", "200"))
+    MUTATION_SECONDS = float(os.environ.get(
+        "KNN_BENCH_MUTATION_SECONDS", "2.0"))
+    MUTATION_WRITE_FRACTION = float(os.environ.get(
+        "KNN_BENCH_MUTATION_WRITE_FRACTION", "0.15"))
 except Exception as _e:  # bad env: the one-JSON-line contract still holds
     print(json.dumps({
         "metric": "knn_qps_config", "value": None, "unit": "queries/s",
@@ -899,6 +910,99 @@ def main() -> None:
             "tenants": KNEE_TENANTS,
         }
 
+    def sweep_mutation():
+        """Opt-in mixed read+write traffic proof (knn_tpu.index): a
+        MutableIndex-backed serving stack (bucketed engine + delta
+        tail + micro-batching queue) driven by a seeded open-loop
+        schedule whose tenants carry a write stream, with background
+        compaction thresholds sized so the run crosses >= 2 snapshot
+        swaps.  Emits the validated ``mutation`` artifact block
+        (knn_tpu.index.artifact) — admitted-read p99 beside write
+        counts, compactions, and SLO breach transitions."""
+        from knn_tpu import loadgen, obs
+        from knn_tpu.index.mutable import MutableIndex
+        from knn_tpu.obs import names as _mn
+        from knn_tpu.serving.queue import QueryQueue
+
+        # cap the index's own placement: the mutation line measures
+        # swap behavior under traffic, not raw scan throughput (the
+        # timed modes own that), and compaction re-places the corpus
+        # once per swap
+        n_idx = min(N, 131072)
+        mix_frac = max(0.0, min(1.0, MUTATION_WRITE_FRACTION))
+        insert_frac = round(mix_frac * 2 / 3, 4)
+        delete_frac = round(mix_frac / 3, 4)
+        expected_inserts = MUTATION_RATE * MUTATION_SECONDS * insert_frac
+        idx = MutableIndex(
+            db[:n_idx], mesh=mesh, k=K, metric="l2",
+            train_tile=tile,
+            # ~2 threshold crossings over the run, floor of 8 so tiny
+            # smoke runs still swap at least once
+            compact_tail_rows=max(8, int(expected_inserts / 2.5) or 8))
+        eng = idx.serving_engine(
+            min_bucket=SERVING_MIN_BUCKET or max(1, BATCH // 32),
+            max_bucket=BATCH)
+        eng.warmup()
+        idx.start_compactor()
+        # one write-only tenant at weight = the requested mix: overall
+        # write share == mix_frac for any fraction in (0, 1)
+        tenants = (
+            loadgen.TenantSpec("readers", weight=1.0 - mix_frac,
+                               batch_sizes=(1, 2, 4, 8)),
+            loadgen.TenantSpec("writers", weight=mix_frac,
+                               batch_sizes=(1,),
+                               insert_fraction=round(2 / 3, 4),
+                               delete_fraction=round(1 / 3, 4)),
+        ) if mix_frac else (
+            loadgen.TenantSpec("readers", batch_sizes=(1, 2, 4, 8)),)
+        spec = loadgen.WorkloadSpec(
+            rate_qps=MUTATION_RATE, duration_s=MUTATION_SECONDS,
+            seed=KNEE_SEED, tenants=tenants)
+        def _breach_total():
+            if not obs.enabled():
+                return 0
+            return sum(s["value"] for s in obs.snapshot().get(
+                _mn.SLO_BREACH_TRANSITIONS, {}).get("series", []))
+
+        breach0 = _breach_total()
+        try:
+            with QueryQueue(eng, max_wait_ms=2.0) as q:
+                rep = loadgen.run_workload(
+                    q, loadgen.generate(spec), queries=queries)
+        finally:
+            idx.close()
+        breach1 = _breach_total()
+        st = idx.stats()
+        lat = rep.get("latency_ms") or {}
+        swap_hist = (obs.histogram(_mn.INDEX_SWAP_SECONDS).summary()
+                     if obs.enabled() else None) or {}
+        block = {
+            "mutation_version": 1,
+            "write_mix": {"insert_fraction": insert_frac,
+                          "delete_fraction": delete_frac},
+            "rate_qps": MUTATION_RATE,
+            "duration_s": MUTATION_SECONDS,
+            "index_rows": n_idx,
+            "admitted_p99_ms": lat.get("p99"),
+            "admitted_p50_ms": lat.get("p50"),
+            "achieved_qps": rep.get("achieved_qps"),
+            "compactions": int(st["compactions"]),
+            "epoch": int(st["epoch"]),
+            "swap_seconds_max": swap_hist.get("max"),
+            "reads": {"offered": rep["offered"], "ok": rep["ok"],
+                      "rejected": rep["rejected"],
+                      "shed": rep["shed"], "errors": rep["errors"]},
+            "writes": dict(rep.get("writes") or {}),
+            "slo_breach_transitions": int(breach1 - breach0),
+        }
+        from knn_tpu.index.artifact import validate_mutation_block
+
+        errs = validate_mutation_block(block)
+        if errs:
+            block["validation_errors"] = errs
+        return {"mutation": block,
+                "mutation_admitted_p99_ms": lat.get("p99")}
+
     def sweep_multihost():
         """Multi-host serving measurement, two arms on one line:
 
@@ -1319,6 +1423,15 @@ def main() -> None:
                 entry = {"error": f"{type(e).__name__}: {e}"}
             results[mode] = entry
             continue
+        if mode == "mutation":
+            # live mixed read+write traffic across compaction swaps: a
+            # traffic-shape measurement, never a headline competitor
+            try:
+                entry = sweep_mutation()
+            except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+                entry = {"error": f"{type(e).__name__}: {e}"}
+            results[mode] = entry
+            continue
         if mode == "multihost":
             # hierarchical-merge + host-RAM tier measurement: a
             # topology-shape line, never a headline-number competitor
@@ -1548,6 +1661,17 @@ def main() -> None:
             **({"knee_qps": results["knee"]["knee_qps"]}
                if results["knee"].get("knee_qps") is not None else {}),
         } if results.get("knee", {}).get("loadgen_knee") else {}),
+        # the mixed read+write traffic proof (opt-in mutation mode):
+        # block + hoisted admitted p99 so the artifact refresher
+        # validates it and the sentinel baselines the mixed-traffic
+        # tail (lower-is-better)
+        **({
+            "mutation": results["mutation"]["mutation"],
+            **({"mutation_admitted_p99_ms":
+                results["mutation"]["mutation_admitted_p99_ms"]}
+               if results["mutation"].get("mutation_admitted_p99_ms")
+               is not None else {}),
+        } if results.get("mutation", {}).get("mutation") else {}),
         # the multi-host topology measurement (opt-in multihost mode):
         # block + hoisted summary fields so the artifact refresher
         # validates it (crossover.validate_multihost_block) and the

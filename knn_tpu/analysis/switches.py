@@ -63,6 +63,7 @@ def _s(name, kind, consumer, doc, description, **kw) -> Switch:
 _OBS = "docs/OBSERVABILITY.md"
 _PERF = "docs/PERF.md"
 _SERVING = "docs/serving.md"
+_INDEX = "docs/INDEX.md"
 
 SWITCHES: Tuple[Switch, ...] = (
     # --- root namespaces (prefix scans + conftest scrubbing) -----------
@@ -140,6 +141,29 @@ SWITCHES: Tuple[Switch, ...] = (
     _s("KNN_TPU_HOSTTIER_DEPTH", "int", "knn_tpu/parallel/sharded.py",
        _PERF, "Bounded in-flight sweep depth of the host-RAM tier's "
        "dispatch-ahead stream (default 2)."),
+    # --- mutable index (knn_tpu.index.mutable) -------------------------
+    _s("KNN_TPU_DELTA_MIN_ROWS", "int", "knn_tpu/index/mutable.py",
+       _INDEX, "Smallest delta-tail capacity ladder rung (rows, "
+       "default 256); the tail re-places within a rung without "
+       "recompiling."),
+    _s("KNN_TPU_DELTA_MAX_ROWS", "int", "knn_tpu/index/mutable.py",
+       _INDEX, "Top delta-tail ladder rung: insert refuses loudly past "
+       "it until compaction folds the tail in (default 65536)."),
+    _s("KNN_TPU_DELTA_RESERVE", "int", "knn_tpu/index/mutable.py",
+       _INDEX, "Certify-widening reserve: searches select k + reserve "
+       "so up to this many tombstones can be masked exactly "
+       "(default 32); delete refuses past it."),
+    _s("KNN_TPU_COMPACT_TAIL_ROWS", "int", "knn_tpu/index/mutable.py",
+       _INDEX, "Auto-compaction threshold on delta-tail rows (unset = "
+       "manual/interval compaction only)."),
+    _s("KNN_TPU_COMPACT_TOMBSTONES", "int", "knn_tpu/index/mutable.py",
+       _INDEX, "Auto-compaction threshold on pending tombstones "
+       "(unset = manual/interval compaction only)."),
+    _s("KNN_TPU_COMPACT_INTERVAL_S", "float",
+       "knn_tpu/index/mutable.py", _INDEX,
+       "Background compactor period: fold pending writes in every "
+       "this-many seconds even below the thresholds (unset = "
+       "threshold-triggered only)."),
     # --- admission control (knn_tpu.serving.admission) -----------------
     _s("KNN_TPU_ADMISSION_", "family", "knn_tpu/serving/admission.py",
        _SERVING, "Admission-control knob family (ANY set member is an "
@@ -170,7 +194,7 @@ SWITCHES: Tuple[Switch, ...] = (
        "Named benchmark config: sift1m (default) | glove | gist1m."),
     _s("KNN_BENCH_MODES", "spec", "bench.py", _PERF,
        "Comma list of modes to run (exact, certified_approx, "
-       "certified_pallas, serving, knee, multihost)."),
+       "certified_pallas, serving, knee, multihost, mutation)."),
     _s("KNN_BENCH_MULTIHOST_HOSTS", "int", "bench.py", _PERF,
        "Host-axis size of the multihost mode's hierarchical mesh "
        "(default 2)."),
@@ -260,6 +284,18 @@ SWITCHES: Tuple[Switch, ...] = (
        "Dispatch-ahead depth of the serving mode."),
     _s("KNN_BENCH_SERVING_MIN_BUCKET", "int", "bench.py", _PERF,
        "Smallest bucket rung of the serving mode's ladder."),
+    # --- bench.py: mutation sweep (opt-in mutation mode) ---------------
+    _s("KNN_BENCH_MUTATION_", "family", "bench.py", _INDEX,
+       "Mutation-sweep knob family of the opt-in mutation mode.",
+       family=True),
+    _s("KNN_BENCH_MUTATION_RATE", "float", "bench.py", _INDEX,
+       "Offered request rate (req/s) of the mixed read+write "
+       "scenario."),
+    _s("KNN_BENCH_MUTATION_SECONDS", "float", "bench.py", _INDEX,
+       "Duration of the mixed-traffic run."),
+    _s("KNN_BENCH_MUTATION_WRITE_FRACTION", "float", "bench.py",
+       _INDEX, "Fraction of scheduled requests that are writes "
+       "(split between inserts and deletes)."),
     # --- bench.py: knee sweep ------------------------------------------
     _s("KNN_BENCH_KNEE_", "family", "bench.py", _PERF,
        "Knee-sweep knob family of the opt-in knee mode.", family=True),

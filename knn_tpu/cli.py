@@ -105,6 +105,16 @@ as one trailing JSON line — the same block bench.py's ``knee`` mode
 embeds and ``refresh_bench_artifacts.py`` curates.  Admission flags
 (``--max-depth``/``--shed``/``--quota``) exercise the brownout
 controls (docs/serving.md).
+
+    python -m knn_tpu.cli index --port 9100
+    python -m knn_tpu.cli index --snapshot run_metrics.json
+    python -m knn_tpu.cli index --selftest
+
+renders the mutable-index state (epoch, delta-tail fill, tombstones,
+compaction history — knn_tpu.index, docs/INDEX.md) from a live
+``/statusz`` or an offline snapshot, jax-free; ``--selftest`` builds a
+tiny index live and verifies the insert/delete/compact mutation oracle
+bitwise (exit 0 on a match).
 """
 
 from __future__ import annotations
@@ -887,6 +897,116 @@ def run_loadgen(args: argparse.Namespace) -> int:
     return 0
 
 
+def build_index_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu index",
+        description="Mutable-index introspection and self-test "
+        "(knn_tpu.index, docs/INDEX.md).  --port/--snapshot render "
+        "the registered indexes' epoch/tail/tombstone/compaction "
+        "state from a live /statusz or an offline snapshot, jax-free "
+        "(exit 0 when every index reports, 2 when none is registered, "
+        "1 unreachable source).  --selftest builds a tiny synthetic "
+        "MutableIndex, runs an insert/delete/compact cycle, and "
+        "verifies the mutation oracle (search_certified bitwise vs a "
+        "fresh index of the surviving rows) live — exit 0 on a "
+        "bitwise match.")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--port", type=int, default=None,
+                     help="fetch /statusz from http://HOST:PORT")
+    src.add_argument("--snapshot", default=None, metavar="PATH",
+                     help="read an atomic JSON snapshot file")
+    src.add_argument("--selftest", action="store_true",
+                     help="run the live insert/delete/compact oracle "
+                     "check (imports JAX)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="endpoint host for --port (default localhost)")
+    p.add_argument("--json", action="store_true",
+                   help="print raw JSON instead of the rendering")
+    return p
+
+
+def run_index(args: argparse.Namespace) -> int:
+    """The `index` subcommand: jax-free status render, or the live
+    self-test (the one mode that imports JAX)."""
+    import json
+
+    if args.selftest:
+        return _run_index_selftest(args)
+    import urllib.request
+
+    from knn_tpu.obs import health
+
+    if args.port is not None:
+        url = f"http://{args.host}:{args.port}/statusz"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                report = json.loads(r.read().decode())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"statusz endpoint {url} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        try:
+            with open(args.snapshot) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read snapshot {args.snapshot}: {e}",
+                  file=sys.stderr)
+            return 1
+        report = health.report_from_snapshot(payload)
+    section = report.get("index") or []
+    if args.json:
+        print(json.dumps(section, indent=1, sort_keys=True,
+                         default=str))
+    else:
+        if not section:
+            print("no mutable index registered in this process")
+        for line in health.render_text(report).splitlines():
+            if line.startswith("index["):
+                print(line)
+    return 0 if section else 2
+
+
+def _run_index_selftest(args: argparse.Namespace) -> int:
+    import json
+
+    import numpy as np
+
+    from knn_tpu.index.mutable import MutableIndex
+    from knn_tpu.parallel.mesh import make_mesh
+    from knn_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(8)
+    rng = np.random.default_rng(0)
+    db = rng.normal(size=(600, 16)).astype(np.float32) * 10
+    q = rng.normal(size=(8, 16)).astype(np.float32) * 10
+    mesh = make_mesh()
+    idx = MutableIndex(db, mesh=mesh, k=5, reserve=8)
+    idx.insert(rng.normal(size=(6, 16)).astype(np.float32) * 10,
+               np.arange(1000, 1006))
+    idx.delete([3, 11, 40])
+    d_m, i_m, _ = idx.search_certified(q)
+    surv = np.ones(600, bool)
+    surv[[3, 11, 40]] = False
+    rows = np.concatenate([db[surv], idx._snapshot().tail])
+    ids = np.concatenate([np.arange(600)[surv],
+                          np.arange(1000, 1006)])
+    fresh = MutableIndex(rows, ids, mesh=mesh, k=5, reserve=8)
+    d_f, i_f, _ = fresh.search_certified(q)
+    oracle_ok = bool(np.array_equal(d_m, d_f)
+                     and np.array_equal(i_m, i_f))
+    rep = idx.compact()
+    d_c, i_c, _ = idx.search_certified(q)
+    compact_ok = bool(np.array_equal(d_c, d_f)
+                      and np.array_equal(i_c, i_f))
+    out = {"ok": oracle_ok and compact_ok,
+           "oracle_bitwise": oracle_ok,
+           "post_compact_bitwise": compact_ok,
+           "compaction": rep, "stats": idx.stats()}
+    print(json.dumps(out, sort_keys=True, default=str))
+    return 0 if out["ok"] else 1
+
+
 def build_campaign_parser() -> argparse.ArgumentParser:
     from knn_tpu.campaign import ARM_KNOBS, DEFAULT_ARMS
 
@@ -1088,6 +1208,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_metrics(build_metrics_parser().parse_args(argv[1:]))
     if argv[:1] == ["doctor"]:
         return run_doctor(build_doctor_parser().parse_args(argv[1:]))
+    if argv[:1] == ["index"]:
+        return run_index(build_index_parser().parse_args(argv[1:]))
     if argv[:1] == ["roofline"]:
         return run_roofline(build_roofline_parser().parse_args(argv[1:]))
     if argv[:1] == ["waterfall"]:

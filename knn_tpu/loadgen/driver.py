@@ -63,6 +63,10 @@ class ResultLog:
         self._dropped = 0
         self._outcomes: Dict[str, int] = {}
         self._by_tenant: Dict[str, Dict[str, int]] = {}
+        #: write-op outcome counts, kind -> outcome -> n (kept apart
+        #: from the read outcomes above: a write's latency must never
+        #: pollute the ADMITTED-read percentiles the SLO judges)
+        self._writes: Dict[str, Dict[str, int]] = {}
         #: (tenant, latency_s, trace_id) of ok-outcome requests, bounded
         #: with the records (percentiles are window truth, counts are
         #: lifetime); the trace id is what joins a knee artifact's tail
@@ -70,11 +74,16 @@ class ResultLog:
         self._lat: deque = deque(maxlen=int(cap))
 
     def add(self, rec: dict) -> None:
+        kind = rec.get("kind", "query")
         with self._lock:
             if len(self._records) == self._records.maxlen:
                 self._dropped += 1
             self._records.append(rec)
             out = rec["outcome"]
+            if kind != "query":
+                slot = self._writes.setdefault(kind, {})
+                slot[out] = slot.get(out, 0) + 1
+                return
             self._outcomes[out] = self._outcomes.get(out, 0) + 1
             slot = self._by_tenant.setdefault(rec["tenant"], {})
             slot[out] = slot.get(out, 0) + 1
@@ -92,6 +101,8 @@ class ResultLog:
                 "outcomes": dict(self._outcomes),
                 "by_tenant": {t: dict(v)
                               for t, v in self._by_tenant.items()},
+                "writes": {k: dict(v)
+                           for k, v in self._writes.items()},
                 "records_kept": len(self._records),
                 "records_dropped": self._dropped,
                 "latencies": list(self._lat),
@@ -113,17 +124,32 @@ def _outcome_of(exc: Exception) -> str:
     return "error"
 
 
+#: base for the driver's deterministic write-id series — far above any
+#: realistic corpus id, so generated inserts can't collide with base ids
+WRITE_ID_BASE = 1 << 40
+
+
 def run_workload(target, requests: Sequence[Request], *, queries,
                  submitters: int = 2, waiters: int = 2,
                  log_cap: int = DEFAULT_LOG_CAP,
                  time_scale: float = 1.0,
-                 include_records: bool = False) -> dict:
+                 include_records: bool = False,
+                 write_id_base: int = WRITE_ID_BASE) -> dict:
     """Drive ``requests`` against ``target`` open-loop and return the
     :func:`report`.  ``queries`` is the row pool requests slice their
     payload from (content is irrelevant to load; shape fidelity is
     what matters).  ``time_scale`` stretches (>1) or compresses (<1)
     the schedule — compressing a recorded trace is how a replay
-    becomes a stress test."""
+    becomes a stress test.
+
+    Write requests (``Request.kind`` insert/delete — the TenantSpec
+    write-stream mix) go through ``target.submit_write``: inserts
+    allocate ids from a monotone series starting at ``write_id_base``
+    (fresh target per run, or pass a disjoint base), deletes retire the
+    oldest still-live inserted id (none live yet -> the explicit
+    ``skipped:no_live_id`` outcome, never an error).  Their outcomes
+    land in the log's ``writes`` section and NEVER in the admitted-read
+    latency percentiles."""
     if not requests:
         raise ValueError("empty request schedule")
     if submitters < 1 or waiters < 1:
@@ -136,11 +162,55 @@ def run_workload(target, requests: Sequence[Request], *, queries,
         raise ValueError(
             f"queries pool has {pool.shape[0]} rows; schedule needs "
             f"{max_rows}")
+    has_writes = any(r.kind != "query" for r in requests)
+    if has_writes and not hasattr(target, "submit_write"):
+        raise ValueError(
+            f"schedule carries write ops but target "
+            f"{type(target).__name__} has no submit_write (drive a "
+            f"MutableServingEngine-backed queue, or the synthetic "
+            f"target)")
     log = ResultLog(log_cap)
+    import itertools
     import queue as _q
 
     inflight: _q.Queue = _q.Queue()
+    #: monotone insert-id series + the live-id pool deletes draw from
+    #: (pushed by the waiter on confirmed inserts)
+    id_seq = itertools.count(int(write_id_base))
+    id_lock = threading.Lock()
+    live_ids: deque = deque()
     t0 = time.monotonic()
+
+    def _submit_write(r: Request, t_sub: float, base: dict) -> None:
+        base["kind"] = r.kind
+        if r.kind == "insert":
+            with id_lock:
+                ids = [next(id_seq) for _ in range(r.rows)]
+            base["write_ids"] = ids
+            kwargs = {"vectors": pool[: r.rows], "ids": ids}
+        else:
+            with id_lock:
+                wid = live_ids.popleft() if live_ids else None
+            if wid is None:
+                log.add({**base, "outcome": "skipped:no_live_id",
+                         "dispatch_s": None, "completion_s": None,
+                         "latency_s": None})
+                return
+            base["write_ids"] = [wid]
+            kwargs = {"ids": [wid]}
+        try:
+            fut = target.submit_write(r.kind, tenant=r.tenant,
+                                      **kwargs)
+        except Exception as e:  # noqa: BLE001 — recorded, not fatal
+            log.add({**base, "outcome": "error",
+                     "error": f"{type(e).__name__}: {e}",
+                     "dispatch_s": None, "completion_s": None,
+                     "latency_s": None})
+            return
+        base["trace_id"] = getattr(fut, "trace_id", None)
+        fut.add_done_callback(
+            lambda f: setattr(f, "done_t", time.monotonic()))
+        inflight.put((base, fut, t_sub))
 
     def _submit(part: List[Request]) -> None:
         for r in part:
@@ -156,6 +226,9 @@ def run_workload(target, requests: Sequence[Request], *, queries,
                 "deadline_ms": r.deadline_ms,
                 "priority": r.priority,
             }
+            if r.kind != "query":
+                _submit_write(r, t_sub, base)
+                continue
             try:
                 fut = target.submit(
                     pool[: r.rows], tenant=r.tenant,
@@ -198,6 +271,11 @@ def run_workload(target, requests: Sequence[Request], *, queries,
                 outcome = _outcome_of(e)
                 if outcome == "error":
                     err = f"{type(e).__name__}: {e}"
+            if outcome == "ok" and base.get("kind") == "insert":
+                # confirmed inserts feed the delete-id pool: a delete
+                # can only ever target a row the target acknowledged
+                with id_lock:
+                    live_ids.extend(base["write_ids"])
             t_done = getattr(fut, "done_t", None) or time.monotonic()
             disp = getattr(fut, "dispatch_t", None)
             log.add({
@@ -238,8 +316,15 @@ def run_workload(target, requests: Sequence[Request], *, queries,
 
 def report(log: ResultLog, *, offered: int, wall_s: float) -> dict:
     """Aggregate the log: overall + per-tenant outcome counts, ADMITTED
-    latency percentiles, achieved q/s, shed fraction."""
+    latency percentiles, achieved q/s, shed fraction.  Schedules with a
+    write stream also carry a ``writes`` section (per-kind outcome
+    counts); every read-side number — offered, shed fraction,
+    percentiles — covers QUERIES only, so a write mix can never dilute
+    the admitted-read latency story."""
     snap = log.snapshot()
+    writes = snap.get("writes") or {}
+    n_writes = sum(sum(v.values()) for v in writes.values())
+    offered -= n_writes  # read-side offered: queries only
     outcomes = snap["outcomes"]
     ok = outcomes.get("ok", 0)
     rejected = sum(v for k, v in outcomes.items()
@@ -287,6 +372,14 @@ def report(log: ResultLog, *, offered: int, wall_s: float) -> dict:
                                     key=lambda x: -x[1])[:5]
         ],
         "per_tenant": per_tenant,
+        # write-stream outcome counts (kind -> outcome -> n), present
+        # only when the schedule carried writes — the replayable
+        # mixed-scenario record beside the read-side numbers
+        **({"writes": {
+            **{k: dict(v) for k, v in writes.items()},
+            "total": n_writes,
+            "ok": sum(v.get("ok", 0) for v in writes.values()),
+        }} if writes else {}),
         "records_kept": snap["records_kept"],
         "records_dropped": snap["records_dropped"],
     }

@@ -45,6 +45,9 @@ class SyntheticTarget:
         self.shed_deadlines = bool(shed_deadlines)
         self._q: _queue.Queue = _queue.Queue()
         self._depth = 0  # tracked explicitly: Queue.qsize is advisory
+        #: write-op counts by kind (submit_write — the driver's
+        #: write-stream accounting exercises against this)
+        self.writes: dict = {}
         self._lock = threading.Lock()
         self._worker = threading.Thread(
             target=self._serve, name="synthetic-target", daemon=True)
@@ -68,6 +71,27 @@ class SyntheticTarget:
         fut.trace_id = new_trace_id()
         deadline = None if deadline_ms is None else now + deadline_ms / 1e3
         self._q.put((fut, tenant, deadline))
+        return fut
+
+    def submit_write(self, kind: str, *, vectors=None, ids=None,
+                     tenant: Optional[str] = None) -> Future:
+        """Write-path double (the QueryQueue.submit_write surface): a
+        synthetic index applies writes instantly, so the future
+        resolves at submit and the counts land in ``self.writes`` —
+        enough to exercise the driver's write-stream accounting without
+        a device."""
+        from knn_tpu.obs import new_trace_id
+
+        if kind not in ("insert", "delete"):
+            raise ValueError(
+                f"unknown write kind {kind!r}; expected insert|delete")
+        fut: Future = Future()
+        fut.trace_id = new_trace_id()
+        with self._lock:
+            self.writes[kind] = self.writes.get(kind, 0) + 1
+        fut.dispatch_t = time.monotonic()
+        fut.set_result({"op": kind,
+                        "rows": 0 if ids is None else len(ids)})
         return fut
 
     def _serve(self) -> None:

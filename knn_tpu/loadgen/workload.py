@@ -60,6 +60,16 @@ class TenantSpec:
     #: dispatch priority (lower first; admission aging keeps it
     #: starvation-safe)
     priority: int = 0
+    #: deterministic write-stream mix: each scheduled request is an
+    #: ``insert`` with probability ``insert_fraction`` and a ``delete``
+    #: with probability ``delete_fraction`` (seeded draw — same spec,
+    #: same kinds), a query otherwise.  Inserts carry ``write_rows``
+    #: vectors; deletes target one previously inserted id (the driver
+    #: allocates/retires ids).  Both zero = the pre-write schedule,
+    #: draw for draw.
+    insert_fraction: float = 0.0
+    delete_fraction: float = 0.0
+    write_rows: int = 1
 
     def validate(self) -> None:
         if self.weight <= 0:
@@ -74,6 +84,16 @@ class TenantSpec:
             raise ValueError(
                 f"tenant {self.name!r}: deadline_ms must be > 0, got "
                 f"{self.deadline_ms}")
+        if self.insert_fraction < 0 or self.delete_fraction < 0 \
+                or self.insert_fraction + self.delete_fraction > 1:
+            raise ValueError(
+                f"tenant {self.name!r}: write fractions must be >= 0 "
+                f"and sum to <= 1, got insert={self.insert_fraction} "
+                f"delete={self.delete_fraction}")
+        if self.write_rows < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: write_rows must be >= 1, got "
+                f"{self.write_rows}")
 
 
 @dataclass(frozen=True)
@@ -90,6 +110,10 @@ class Request:
     precision: Optional[str] = None
     deadline_ms: Optional[float] = None
     priority: int = 0
+    #: "query" | "insert" | "delete" — writes ride the same seeded
+    #: open-loop schedule as reads (TenantSpec write fractions); old
+    #: traces without the field load as pure-query schedules
+    kind: str = "query"
 
 
 @dataclass(frozen=True)
@@ -207,10 +231,25 @@ def generate(spec: WorkloadSpec) -> List[Request]:
         ten = spec.tenants[int(pick)]
         rows = int(ten.batch_sizes[int(
             rng.integers(0, len(ten.batch_sizes)))])
+        kind = "query"
+        if ten.insert_fraction > 0 or ten.delete_fraction > 0:
+            # the kind draw happens ONLY for write-mixed tenants, so a
+            # write-free spec's rng sequence — and therefore its whole
+            # schedule — is unchanged draw for draw (pinned)
+            u = float(rng.random())
+            if u < ten.insert_fraction:
+                kind = "insert"
+            elif u < ten.insert_fraction + ten.delete_fraction:
+                kind = "delete"
+        if kind == "insert":
+            rows = ten.write_rows
+        elif kind == "delete":
+            rows = 1
         out.append(Request(
             tenant=ten.name, t=round(float(t), 6), rows=rows, k=ten.k,
             metric=ten.metric, precision=ten.precision,
-            deadline_ms=ten.deadline_ms, priority=ten.priority))
+            deadline_ms=ten.deadline_ms, priority=ten.priority,
+            kind=kind))
     return out
 
 

@@ -48,6 +48,7 @@ REPORT_ALERTS = 20
 _lock = threading.Lock()
 _engines: List[weakref.ref] = []
 _queues: List[weakref.ref] = []
+_indexes: List[weakref.ref] = []
 
 
 def register_engine(engine) -> None:
@@ -70,11 +71,22 @@ def register_queue(queue) -> None:
             _queues.append(weakref.ref(queue))
 
 
+def register_index(index) -> None:
+    """Called by MutableIndex.__init__ (no-op when obs is disabled)."""
+    if not registry.enabled():
+        return
+    with _lock:
+        _indexes[:] = [r for r in _indexes if r() is not None]
+        if not any(r() is index for r in _indexes):
+            _indexes.append(weakref.ref(index))
+
+
 def reset() -> None:
     """Drop every registration (test isolation)."""
     with _lock:
         _engines.clear()
         _queues.clear()
+        _indexes.clear()
 
 
 def _live_components():
@@ -298,7 +310,23 @@ def report(slo_section: Optional[dict] = None,
         # attribution (per-host walls, gap, DCN volume/strategy) —
         # None until a MultiHostKNN merge ran in this process
         "multihost": _multihost_status(),
+        # mutable indexes registered in this process (knn_tpu.index):
+        # epoch / delta-tail / tombstone / compaction state — the
+        # write-path health beside the read-path numbers above
+        "index": _index_status(),
     }
+
+
+def _index_status() -> list:
+    with _lock:
+        indexes = [i for i in (r() for r in _indexes) if i is not None]
+    out = []
+    for idx in indexes:
+        try:
+            out.append(idx.stats())
+        except Exception as e:  # noqa: BLE001 - probe must not die on it
+            out.append({"error": f"{type(e).__name__}: {e}"})
+    return out
 
 
 def _multihost_status() -> Optional[dict]:
@@ -337,7 +365,7 @@ def report_from_snapshot(payload: dict) -> dict:
                     "reason": "not recorded in this snapshot"},
         "engines": [], "queues": [],
         "tune_cache": {}, "roofline": {}, "calibration": {}, "slo": {},
-        "multihost": None,
+        "multihost": None, "index": [],
         "active_breaches": [], "alerts": [],
         "slowest_requests": [], "postmortems": {},
     }
@@ -415,6 +443,21 @@ def render_text(rep: dict) -> str:
         lines.append("calibration: no store configured "
                      "(KNN_TPU_CALIBRATION unset) — roofline verdicts "
                      "are analytic only")
+    for i, ix in enumerate(rep.get("index") or []):
+        if "error" in ix:
+            lines.append(f"index[{i}]: status unavailable "
+                         f"({ix['error']})")
+            continue
+        lc = ix.get("last_compaction") or {}
+        lines.append(
+            f"index[{i}]: epoch={ix.get('epoch')} "
+            f"rows={ix.get('rows')} tail={ix.get('tail_rows')}"
+            f"/{ix.get('tail_capacity')} "
+            f"tombstones={ix.get('tombstones')}/{ix.get('budget')} "
+            f"live={ix.get('live_rows')} "
+            f"compactions={ix.get('compactions')}"
+            + (f" (last swap {lc.get('swap_s')}s)" if lc else "")
+            + (" compactor=up" if ix.get("compactor_alive") else ""))
     mh = rep.get("multihost")
     if mh:
         walls = mh.get("host_walls_s") or []

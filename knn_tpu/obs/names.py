@@ -114,6 +114,13 @@ HOSTTIER_SWEEPS = "knn_tpu_hosttier_sweeps_total"
 HOSTTIER_SEGMENT_ROWS = "knn_tpu_hosttier_segment_rows"
 HOSTTIER_SWEEP_SECONDS = "knn_tpu_hosttier_sweep_seconds"
 
+# --- mutable index (knn_tpu.index.mutable) -----------------------------
+INDEX_EPOCH = "knn_tpu_index_epoch"
+INDEX_TAIL_ROWS = "knn_tpu_index_tail_rows"
+INDEX_TOMBSTONES = "knn_tpu_index_tombstones"
+INDEX_COMPACTIONS = "knn_tpu_index_compactions_total"
+INDEX_SWAP_SECONDS = "knn_tpu_index_swap_seconds"
+
 #: name -> (type, label names, help).  Types: "counter" (monotone,
 #: float-valued so second-counters work), "gauge", "histogram" (bounded
 #: sample window + lifetime count/sum; exported as a Prometheus summary).
@@ -360,4 +367,26 @@ CATALOG = {
         "histogram", (),
         "Wall seconds per host-RAM tier sweep (dispatch to fetch of "
         "one segment) — flat across sweeps when the stream overlaps."),
+    INDEX_EPOCH: (
+        "gauge", (),
+        "Current snapshot epoch of the mutable index — bumps once per "
+        "compaction swap (knn_tpu.index.mutable)."),
+    INDEX_TAIL_ROWS: (
+        "gauge", (),
+        "Rows currently in the mutable index's delta tail (searched "
+        "alongside the main placement; compaction folds them in)."),
+    INDEX_TOMBSTONES: (
+        "gauge", (),
+        "Ids currently tombstoned in the mutable index — masked out of "
+        "every merged select under the certify reserve; compaction "
+        "drops the rows and resets this."),
+    INDEX_COMPACTIONS: (
+        "counter", (),
+        "Completed compaction cycles (tail merged + tombstones "
+        "dropped into a fresh placement, snapshot-swapped in)."),
+    INDEX_SWAP_SECONDS: (
+        "histogram", (),
+        "Seconds the compaction's atomic pointer swap held the index "
+        "lock — the only slice of a compaction that can contend with "
+        "the serving path (the build/warm runs off it)."),
 }

@@ -442,6 +442,28 @@ class MultiHostKNN:
             self._local_report(wall)
         return d, gi, stats
 
+    # -- mutation refusals (knn_tpu.index, docs/INDEX.md) ---------------
+    def _refuse_mutation(self, what: str):
+        from knn_tpu.index.artifact import MutationUnsupportedError
+
+        raise MutationUnsupportedError(
+            f"{what}: MultiHostKNN spans {self.process_count} "
+            f"process(es) with no write replication protocol — a "
+            f"single-host write would silently serve stale results "
+            f"from the other hosts; rebuild the replica from the "
+            f"updated corpus, or serve a mutable corpus from a "
+            f"single-host MutableIndex (docs/INDEX.md)")
+
+    def insert(self, vectors=None, ids=None):
+        """LOUD refusal — see :mod:`knn_tpu.index` for the single-host
+        mutable path."""
+        self._refuse_mutation("insert")
+
+    def delete(self, ids=None):
+        """LOUD refusal — see :mod:`knn_tpu.index` for the single-host
+        mutable path."""
+        self._refuse_mutation("delete")
+
 
 __all__ = [
     "initialize",

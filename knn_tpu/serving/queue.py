@@ -252,6 +252,60 @@ class QueryQueue:
             obs.counter(mn.TENANT_REQUESTS, tenant=tenant).inc()
         return fut
 
+    def submit_write(self, kind: str, *, vectors=None, ids=None,
+                     tenant: Optional[str] = None) -> Future:
+        """Writes as a first-class op beside queries: route an
+        ``insert``/``delete`` to the engine's mutable index
+        (:meth:`~knn_tpu.index.mutable.MutableServingEngine.
+        apply_write`) and return a resolved Future carrying the write
+        report (or the index's refusal).  Writes apply IMMEDIATELY
+        under the index's own lock — snapshot pinning, not queue
+        ordering, is what makes them atomic against in-flight
+        micro-batches — so they never ride (or stall) a coalesced
+        device dispatch.  The queue's ``stats()`` gains a ``writes``
+        section once any write passed through (the write-free stats
+        shape is part of the pre-index bitwise contract)."""
+        apply = getattr(self.engine, "apply_write", None)
+        if apply is None:
+            raise ValueError(
+                f"this queue's engine ({type(self.engine).__name__}) "
+                f"serves an immutable placement — writes need a "
+                f"MutableServingEngine (knn_tpu.index, docs/INDEX.md)")
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("QueryQueue is closed")
+        fut: Future = Future()
+        tid = obs.new_trace_id()
+        fut.trace_id = tid
+        t0 = time.monotonic()
+        try:
+            out = apply(kind, vectors=vectors, ids=ids)
+        except Exception as e:  # noqa: BLE001 — outcome, not crash
+            self._count_write(kind, error=True, tenant=tenant)
+            fut.set_exception(e)
+        else:
+            self._count_write(kind, error=False, tenant=tenant)
+            fut.set_result(out)
+        fut.dispatch_t = time.monotonic()
+        obs.record_span(
+            "serving.write", tid, time.monotonic() - t0, kind=kind,
+            **({"tenant": tenant} if tenant is not None else {}))
+        return fut
+
+    def _count_write(self, kind: str, *, error: bool,
+                     tenant: Optional[str]) -> None:
+        with self._cond:
+            w = self._stats.setdefault(
+                "writes", {"insert": 0, "delete": 0, "errors": 0})
+            if error:
+                w["errors"] += 1
+            elif kind in ("insert", "delete"):
+                w[kind] += 1
+        if tenant is not None:
+            obs.counter(mn.TENANT_REQUESTS, tenant=tenant).inc()
+            if error:
+                obs.counter(mn.TENANT_ERRORS, tenant=tenant).inc()
+
     def close(self) -> None:
         """Flush every pending request, then stop both threads."""
         with self._cond:

@@ -46,7 +46,7 @@ if [ "${1:-}" = "--fast" ]; then
     tests/test_slo.py tests/test_sentinel.py tests/test_roofline.py \
     tests/test_calibrate.py \
     tests/test_loadgen.py tests/test_admission.py \
-    tests/test_waterfall.py \
+    tests/test_waterfall.py tests/test_index.py \
     tests/test_multihost.py tests/test_hosttier.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi

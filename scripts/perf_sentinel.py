@@ -145,6 +145,26 @@ def run_lint(repo) -> int:
                   f"across {n_knee} blocks")
     except Exception as e:  # noqa: BLE001
         errors.append(f"knee blocks: {type(e).__name__}: {e}")
+    try:
+        from knn_tpu.index.artifact import validate_mutation_block
+
+        n_mut, n_before = 0, len(errors)
+        for rec in records:
+            block = rec.get("mutation")
+            if block is None:
+                continue
+            n_mut += 1
+            for err in validate_mutation_block(block):
+                errors.append(
+                    f"mutation block on {rec.get('metric')} "
+                    f"({rec.get('_source')}): {err}")
+        if len(errors) == n_before:
+            print(f"mutation blocks: OK ({n_mut} validated)")
+        else:
+            print(f"mutation blocks: {len(errors) - n_before} "
+                  f"violation(s) across {n_mut} blocks")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"mutation blocks: {type(e).__name__}: {e}")
     for err in errors:
         print(f"perf_sentinel --lint: {err}", file=sys.stderr)
     return 1 if errors else 0

@@ -271,6 +271,35 @@ except Exception as _e:  # noqa: BLE001 — curation must never fail on it
     print(f"knee curation skipped: {type(_e).__name__}: {_e}",
           file=sys.stderr)
 
+# mutation curation (knn_tpu.index.artifact): a fresh line carrying a
+# `mutation` block (bench's opt-in mutation mode — mixed read+write
+# traffic across compaction swaps) is validated — malformed blocks
+# REFUSED, the roofline/knee discipline — with the admitted-read p99
+# hoisted top-level for the sentinel's lower-is-better baseline.
+try:
+    from knn_tpu.index.artifact import (
+        validate_mutation_block as _vmut,
+    )
+
+    for cfg, rec in best.items():
+        if rec["stale"]:
+            continue  # a republished number keeps its old block verbatim
+        block = rec.get("mutation")
+        if block is None:
+            continue
+        errs = _vmut(block)
+        if errs:
+            sys.exit(f"refusing to emit curated line for {cfg}: "
+                     f"malformed mutation block: {'; '.join(errs)}")
+        if block.get("admitted_p99_ms") is not None:
+            rec.setdefault("mutation_admitted_p99_ms",
+                           block["admitted_p99_ms"])
+except SystemExit:
+    raise
+except Exception as _e:  # noqa: BLE001 — curation must never fail on it
+    print(f"mutation curation skipped: {type(_e).__name__}: {_e}",
+          file=sys.stderr)
+
 # multihost curation (knn_tpu.parallel.crossover): a fresh line
 # carrying a `multihost` block (bench's multihost mode — hierarchical
 # merge + host-RAM tier) is validated — malformed blocks REFUSED, the
@@ -354,6 +383,12 @@ with open(DST, "w") as f:
               # session ran one: max SLO-meeting sustained request rate
               + (f" knee={r['knee_qps']}q/s"
                  if isinstance(r.get("knee_qps"), (int, float)) else "")
+              # the mixed-traffic admitted-read p99 (mutation mode),
+              # when the session ran one: the live-mutation tail beside
+              # the read-only numbers
+              + (f" mutation={r['mutation_admitted_p99_ms']}ms/p99"
+                 if isinstance(r.get("mutation_admitted_p99_ms"),
+                               (int, float)) else "")
               # the multi-host topology measurement, when the session
               # ran one: host count x DCN merge strategy + host-RAM
               # tier sweep count

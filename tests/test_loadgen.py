@@ -312,3 +312,136 @@ def test_sentinel_curates_knee_qps():
             "knee_qps": 99.0}
     verdict = sentinel.verdict_for_line(good, baselines=baselines)
     assert verdict["fields"]["knee_qps"]["verdict"] == "ok"
+
+
+# -- write-stream mix (knn_tpu.index satellite) ---------------------------
+def test_write_mix_deterministic_and_replayable(tmp_path):
+    spec = WorkloadSpec(
+        rate_qps=400, duration_s=0.5, seed=3,
+        tenants=(TenantSpec("r", weight=0.7, batch_sizes=(1, 2)),
+                 TenantSpec("w", weight=0.3, batch_sizes=(1,),
+                            insert_fraction=0.5, delete_fraction=0.25,
+                            write_rows=2)))
+    a, b = generate(spec), generate(spec)
+    assert a == b  # element-for-element, kinds included
+    kinds = {k: sum(1 for r in a if r.kind == k)
+             for k in ("query", "insert", "delete")}
+    assert kinds["insert"] > 0 and kinds["delete"] > 0
+    assert all(r.rows == 2 for r in a if r.kind == "insert")
+    assert all(r.rows == 1 for r in a if r.kind == "delete")
+    assert all(r.kind == "query" for r in a if r.tenant == "r")
+    # JSONL round-trip keeps the kind; old-style records (no kind
+    # field) load as pure-query schedules
+    p = tmp_path / "t.jsonl"
+    save_trace(a, str(p))
+    assert load_trace(str(p)) == a
+    p2 = tmp_path / "old.jsonl"
+    p2.write_text('{"tenant": "x", "t": 0.1, "rows": 2}\n')
+    (old,) = load_trace(str(p2))
+    assert old.kind == "query"
+
+
+def test_write_free_schedule_unchanged_by_the_kind_draw():
+    # the kind draw happens ONLY for write-mixed tenants, so a
+    # write-free spec's rng sequence — and therefore its schedule — is
+    # the PRE-write-stream one, draw for draw.  Pinned by replaying
+    # the generator's exact draw protocol with NO kind draw: if the
+    # draw ever moves outside the write-mix guard, every recorded
+    # write-free trace stops replaying deterministically.
+    spec = WorkloadSpec(rate_qps=300, duration_s=0.4, seed=9,
+                        tenants=(TenantSpec("a", batch_sizes=(1, 4)),
+                                 TenantSpec("b", weight=2.0,
+                                            batch_sizes=(2,))))
+    got = generate(spec)
+    assert all(r.kind == "query" for r in got)
+    from knn_tpu.loadgen.workload import _arrival_times
+
+    rng = np.random.default_rng(spec.seed)
+    times = _arrival_times(spec, rng)
+    weights = np.asarray([t.weight for t in spec.tenants], np.float64)
+    picks = rng.choice(2, size=len(times), p=weights / weights.sum())
+    expect = []
+    for t, pick in zip(times, picks):
+        ten = spec.tenants[int(pick)]
+        rows = int(ten.batch_sizes[int(
+            rng.integers(0, len(ten.batch_sizes)))])
+        expect.append((ten.name, round(float(t), 6), rows))
+    assert [(r.tenant, r.t, r.rows) for r in got] == expect
+
+
+def test_write_mix_validation():
+    with pytest.raises(ValueError, match="fractions"):
+        TenantSpec("w", insert_fraction=0.8,
+                   delete_fraction=0.3).validate()
+    with pytest.raises(ValueError, match="fractions"):
+        TenantSpec("w", insert_fraction=-0.1).validate()
+    with pytest.raises(ValueError, match="write_rows"):
+        TenantSpec("w", insert_fraction=0.1, write_rows=0).validate()
+
+
+def test_driver_write_stream_against_synthetic():
+    spec = WorkloadSpec(
+        rate_qps=500, duration_s=0.4, seed=5,
+        tenants=(TenantSpec("r", weight=0.6, batch_sizes=(1,)),
+                 TenantSpec("w", weight=0.4, batch_sizes=(1,),
+                            insert_fraction=0.5,
+                            delete_fraction=0.25)))
+    reqs = generate(spec)
+    n_writes = sum(1 for r in reqs if r.kind != "query")
+    assert n_writes > 0
+    with SyntheticTarget(2000.0) as tgt:
+        rep = run_workload(tgt, reqs, queries=POOL)
+    # report: write counts live apart from the read-side numbers
+    w = rep["writes"]
+    assert w["total"] == n_writes
+    assert w["insert"].get("ok", 0) == tgt.writes.get("insert", 0) > 0
+    # deletes can only target confirmed inserts; early ones skip loudly
+    n_del = sum(1 for r in reqs if r.kind == "delete")
+    del_outcomes = w.get("delete", {})
+    assert sum(del_outcomes.values()) == n_del
+    # read-side numbers cover QUERIES only
+    assert rep["offered"] == len(reqs) - n_writes
+    assert rep["ok"] <= rep["offered"]
+    lat = rep["latency_ms"]
+    assert lat is None or lat["count"] <= rep["ok"]
+
+
+def test_driver_refuses_writes_against_writeless_target():
+    class NoWrites:
+        def submit(self, *a, **k):  # pragma: no cover - never reached
+            raise AssertionError
+
+    spec = WorkloadSpec(
+        rate_qps=200, duration_s=0.2, seed=1,
+        tenants=(TenantSpec("w", batch_sizes=(1,),
+                            insert_fraction=1.0),))
+    with pytest.raises(ValueError, match="submit_write"):
+        run_workload(NoWrites(), generate(spec), queries=POOL)
+
+
+def test_sentinel_curates_mutation_admitted_p99():
+    from knn_tpu.obs import sentinel
+
+    assert ("mutation_admitted_p99_ms", "lower") \
+        in sentinel.CURATED_FIELDS
+    rec = {"metric": "m", "backend": "tpu",
+           "mutation": {"admitted_p99_ms": 12.5}}
+    assert sentinel.curated_value(rec, "mutation_admitted_p99_ms") \
+        == 12.5
+    history = [
+        {"metric": "m", "backend": "tpu", "value": 1.0,
+         "mutation_admitted_p99_ms": 10.0,
+         "measured_at_commit": f"c{i}", "measured_round": i}
+        for i in range(4)
+    ]
+    baselines = sentinel.build_baselines(history)
+    # lower is better: a p99 that DOUBLES regresses, one that halves
+    # reads ok
+    worse = {"metric": "m", "backend": "tpu", "value": 1.0,
+             "mutation_admitted_p99_ms": 25.0}
+    assert sentinel.verdict_for_line(worse, baselines=baselines)[
+        "fields"]["mutation_admitted_p99_ms"]["verdict"] == "regress"
+    better = {"metric": "m", "backend": "tpu", "value": 1.0,
+              "mutation_admitted_p99_ms": 9.5}
+    assert sentinel.verdict_for_line(better, baselines=baselines)[
+        "fields"]["mutation_admitted_p99_ms"]["verdict"] == "ok"

@@ -410,3 +410,42 @@ def test_malformed_multihost_block_refused(tmp_path):
     assert r.returncode != 0
     assert "malformed multihost block" in (r.stderr + r.stdout)
     assert not (tmp_path / "TPU_BENCH_r09.jsonl").exists()
+
+
+def test_mutation_block_curated_and_printed(tmp_path):
+    # a fresh line carrying a mutation block (bench's opt-in mutation
+    # mode — mixed read+write traffic across compaction swaps) gets
+    # admitted_p99_ms hoisted top-level — the sentinel's
+    # lower-is-better curated field — and the per-line print shows
+    # mutation= beside the sentinel verdict
+    block = {
+        "mutation_version": 1,
+        "write_mix": {"insert_fraction": 0.1, "delete_fraction": 0.05},
+        "rate_qps": 200.0, "duration_s": 2.0,
+        "admitted_p99_ms": 14.2, "compactions": 3, "epoch": 3,
+        "reads": {"offered": 360, "ok": 360},
+        "writes": {"insert": {"ok": 40}, "total": 55, "ok": 52},
+        "slo_breach_transitions": 0,
+    }
+    rec = dict(_line(120.0, gate=True, cfg="knn_qps_mutation"),
+               mutation=block)
+    r = _run_with_repo(tmp_path, 9, [rec])
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(ln) for ln in
+            (tmp_path / "TPU_BENCH_r09.jsonl").read_text().splitlines()]
+    (row,) = rows
+    assert row["mutation_admitted_p99_ms"] == 14.2
+    assert row["mutation"] == block
+    assert "mutation=14.2ms/p99" in r.stdout
+
+
+def test_malformed_mutation_block_refused(tmp_path):
+    # a corrupt mutation block would silently poison the sentinel's
+    # mixed-traffic p99 baselines — the refresher must refuse the
+    # round (the roofline/knee/multihost discipline)
+    bad = dict(_line(120.0, gate=True),
+               mutation={"mutation_version": 1, "compactions": 0})
+    r = _run_with_repo(tmp_path, 9, [bad])
+    assert r.returncode != 0
+    assert "malformed mutation block" in (r.stderr + r.stdout)
+    assert not (tmp_path / "TPU_BENCH_r09.jsonl").exists()
