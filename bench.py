@@ -1597,21 +1597,6 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — advisory only
             rl_top = {"error": f"{type(e).__name__}: {e}"}
     rl_fields = {"roofline": rl_top}
-    if isinstance(rl_top, dict):
-        if rl_top.get("roofline_pct") is not None:
-            rl_fields["roofline_pct"] = rl_top["roofline_pct"]
-        if rl_top.get("bound_class"):
-            rl_fields["bound_class"] = rl_top["bound_class"]
-        if rl_top.get("estimated"):
-            rl_fields["roofline_estimated"] = True
-        # calibration drift, hoisted when a measured-term overlay
-        # applied (knn_tpu.obs.calibrate): the sentinel's
-        # model_residual_pct baseline flags a model that starts
-        # mispredicting the machine again
-        cal = rl_top.get("calibration")
-        if isinstance(cal, dict) and cal.get("applied") and \
-                isinstance(cal.get("model_residual_pct"), (int, float)):
-            rl_fields["model_residual_pct"] = cal["model_residual_pct"]
     # quantization provenance: precision rides top-level on EVERY line so
     # int8 A/B lines are self-describing and the artifact refresher can
     # curate them separately from the f32-family line of the same config;
@@ -1654,33 +1639,20 @@ def main() -> None:
                if "obs_overhead_pct" in results["serving"] else {}),
         } if results.get("serving", {}).get("sustained_qps") else {}),
         # the measured latency-vs-throughput knee (opt-in knee mode):
-        # block + hoisted knee_qps so the artifact refresher validates
-        # it and the sentinel baselines it like any curated field
-        **({
-            "loadgen_knee": results["knee"]["loadgen_knee"],
-            **({"knee_qps": results["knee"]["knee_qps"]}
-               if results["knee"].get("knee_qps") is not None else {}),
-        } if results.get("knee", {}).get("loadgen_knee") else {}),
+        # the block rides the line; knee_qps is hoisted by the
+        # catalog-driven loop below
+        **({"loadgen_knee": results["knee"]["loadgen_knee"]}
+           if results.get("knee", {}).get("loadgen_knee") else {}),
         # the mixed read+write traffic proof (opt-in mutation mode):
-        # block + hoisted admitted p99 so the artifact refresher
-        # validates it and the sentinel baselines the mixed-traffic
-        # tail (lower-is-better)
-        **({
-            "mutation": results["mutation"]["mutation"],
-            **({"mutation_admitted_p99_ms":
-                results["mutation"]["mutation_admitted_p99_ms"]}
-               if results["mutation"].get("mutation_admitted_p99_ms")
-               is not None else {}),
-        } if results.get("mutation", {}).get("mutation") else {}),
+        # block on the line, admitted p99 hoisted below
+        **({"mutation": results["mutation"]["mutation"]}
+           if results.get("mutation", {}).get("mutation") else {}),
         # the multi-host topology measurement (opt-in multihost mode):
-        # block + hoisted summary fields so the artifact refresher
-        # validates it (crossover.validate_multihost_block) and the
-        # curated line reads at a glance
+        # block + the mode entry's own qps (not a block field); the
+        # host-tier sweep count hoists below
         **({
             "multihost": results["multihost"]["multihost"],
             "multihost_qps": results["multihost"].get("qps_mean"),
-            "hosttier_sweeps": results["multihost"]["multihost"][
-                "hosttier"]["sweeps"],
         } if results.get("multihost", {}).get("multihost") else {}),
         **(gate or {}),
         "recall_at_k": results[best].get("recall_at_k"),
@@ -1725,6 +1697,18 @@ def main() -> None:
         "approx_knobs": {"recall_target": APPROX_RT,
                          "margin": APPROX_MARGIN},
     }
+    # table-driven hoists over the artifact-schema catalog
+    # (knn_tpu.analysis.artifacts): every cataloged block riding this
+    # line contributes its declared top-level keys — roofline_pct/
+    # bound_class/roofline_estimated off the winning mode's roofline
+    # block, model_residual_pct off an applied calibration overlay,
+    # knee_qps, mutation_admitted_p99_ms, hosttier_sweeps — so the
+    # sentinel's curated-field baselines and the artifact refresher
+    # read them flat.  One loop instead of one stanza per block; a new
+    # bench block hoists by declaring, not by editing this file.
+    from knn_tpu.analysis.artifacts import apply_scope_hoists
+
+    apply_scope_hoists(line, scope="bench")
     # perf-regression sentinel verdict (knn_tpu.obs.sentinel): this
     # line judged against the robust baseline of its own history —
     # advisory on the line itself (check_tier1 --strict is the gate);

@@ -1,7 +1,7 @@
 """knn_tpu.analysis — the repo-native static-analysis suite.
 
 Machine-enforces the invariants every PR has been hand-checking, as
-five registered checkers over a small framework (docs/ANALYSIS.md):
+six registered checkers over a small framework (docs/ANALYSIS.md):
 
 - ``switch-lockstep`` — every ``KNN_TPU_*``/``KNN_BENCH_*`` env switch
   declared in the central catalog (:mod:`knn_tpu.analysis.switches`),
@@ -18,7 +18,15 @@ five registered checkers over a small framework (docs/ANALYSIS.md):
   args;
 - ``vmem-budget`` — every autotuner knob-grid candidate priced against
   per-device-kind VMEM (:mod:`knn_tpu.analysis.vmem`; ``autotune()``
-  refuses over-budget candidates before timing).
+  refuses over-budget candidates before timing);
+- ``artifact-lockstep`` — the artifact pipeline in lockstep with its
+  declarative schema catalog (:mod:`knn_tpu.analysis.artifacts`):
+  every key an emitter writes into a cataloged bench block resolves in
+  its schema, every schema field is emitted or justified-suppressed,
+  the refresher performs every declared hoist, the sentinel derives
+  its curated fields from the catalog, every version token is consumed
+  by exactly one validator, and every block type keeps its docs
+  anchor.
 
 Entry points: ``python -m knn_tpu.cli lint`` (jax-free; exit 0 green,
 1 findings), :func:`run` in-process.  Suppressions require a written
@@ -39,6 +47,7 @@ from knn_tpu.analysis.core import (  # noqa: F401 — the public surface
     load_suppressions,
 )
 from knn_tpu.analysis import (  # noqa: F401 — registration imports
+    check_artifacts,
     check_concurrency,
     check_jax,
     check_metrics,

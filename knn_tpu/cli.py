@@ -1108,10 +1108,11 @@ def build_lint_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="knn_tpu lint",
         description="Run the repo-native static-analysis suite "
-        "(knn_tpu.analysis — docs/ANALYSIS.md): switch/metric lockstep, "
-        "locked-mutation, jax-hygiene, and VMEM-budget checkers over "
-        "the source tree, jax-free.  Exit 0 green (every suppression "
-        "justified), 1 findings (or a broken/stale suppression file).",
+        "(knn_tpu.analysis — docs/ANALYSIS.md): switch/metric/artifact "
+        "lockstep, locked-mutation, jax-hygiene, and VMEM-budget "
+        "checkers over the source tree, jax-free.  Exit 0 green (every "
+        "suppression justified), 1 findings (or a broken/stale "
+        "suppression file).",
     )
     p.add_argument("--root", default=None, metavar="DIR",
                    help="tree to lint (default: the repo this package "

@@ -15,17 +15,23 @@ from typing import List
 
 #: version stamp of the ``mutation`` bench block (bench.py's opt-in
 #: mutation mode); bump on any schema change so the refresher refuses
-#: half-migrated lines instead of hoisting garbage
+#: half-migrated lines instead of hoisting garbage — the version token
+#: the artifact-schema catalog's ``mutation`` entry consumes
 MUTATION_VERSION = 1
+
+
+def _required_fields():
+    from knn_tpu.analysis.artifacts import required_keys
+
+    return required_keys("mutation")
+
 
 #: fields every valid mutation block must carry (the refusal list the
 #: refresher prints); ``admitted_p99_ms`` may be null (an honest "no
-#: admitted reads completed" beats a fabricated number)
-MUTATION_REQUIRED = (
-    "mutation_version", "write_mix", "rate_qps", "duration_s",
-    "admitted_p99_ms", "compactions", "epoch", "reads", "writes",
-    "slo_breach_transitions",
-)
+#: admitted reads completed" beats a fabricated number) — DERIVED from
+#: the artifact-schema catalog (knn_tpu.analysis.artifacts), the one
+#: declaration the validator and the lockstep checker both read
+MUTATION_REQUIRED = _required_fields()
 
 
 class MutationUnsupportedError(ValueError):
@@ -50,52 +56,9 @@ def validate_mutation_block(block) -> List[str]:
     a line carrying a ``mutation`` block: returns the list of
     violations (empty = valid).  Blocks that recorded their own failure
     (an ``error`` key) are exempt — an honest error field beats a
-    refused line (the loadgen_knee discipline)."""
-    errs: List[str] = []
-    if not isinstance(block, dict):
-        return [f"mutation block must be a dict, got "
-                f"{type(block).__name__}"]
-    if "error" in block:
-        return errs
-    for fld in MUTATION_REQUIRED:
-        if fld not in block:
-            errs.append(f"missing {fld!r}")
-    if errs:
-        return errs
-    if block["mutation_version"] != MUTATION_VERSION:
-        errs.append(f"mutation_version must be {MUTATION_VERSION}, got "
-                    f"{block['mutation_version']!r}")
-    mix = block["write_mix"]
-    if not isinstance(mix, dict):
-        errs.append(f"write_mix must be a dict, got {mix!r}")
-    else:
-        for fld in ("insert_fraction", "delete_fraction"):
-            v = mix.get(fld)
-            if not isinstance(v, (int, float)) or not 0.0 <= v <= 1.0:
-                errs.append(f"write_mix.{fld} must be a number in "
-                            f"[0, 1], got {v!r}")
-    for fld in ("rate_qps", "duration_s"):
-        v = block[fld]
-        if not isinstance(v, (int, float)) or v <= 0:
-            errs.append(f"{fld} must be a positive number, got {v!r}")
-    p99 = block["admitted_p99_ms"]
-    if p99 is not None and (not isinstance(p99, (int, float))
-                            or p99 < 0):
-        errs.append(f"admitted_p99_ms must be a non-negative number or "
-                    f"null, got {p99!r}")
-    for fld in ("compactions", "epoch", "slo_breach_transitions"):
-        v = block[fld]
-        if not isinstance(v, int) or v < 0:
-            errs.append(f"{fld} must be a non-negative int, got {v!r}")
-    # the acceptance bar the block exists to pin: a mixed-traffic line
-    # that never swapped proves nothing about swap behavior
-    if isinstance(block.get("compactions"), int) \
-            and block["compactions"] < 1 and "compactions_waived" \
-            not in block:
-        errs.append("compactions must be >= 1 (a mutation line that "
-                    "never compacted measured nothing; set "
-                    "compactions_waived to curate one anyway)")
-    for fld in ("reads", "writes"):
-        if not isinstance(block[fld], dict):
-            errs.append(f"{fld} must be a dict, got {block[fld]!r}")
-    return errs
+    refused line (the loadgen_knee discipline).  A shim over the
+    artifact-schema catalog (:mod:`knn_tpu.analysis.artifacts`, the
+    ``mutation`` entry) with the legacy error strings byte-identical."""
+    from knn_tpu.analysis.artifacts import validate
+
+    return validate("mutation", block, style="legacy")

@@ -31,12 +31,22 @@ from knn_tpu.loadgen import driver
 from knn_tpu.loadgen.workload import WorkloadSpec, generate
 
 #: artifact schema version (bump on shape changes so the refresher can
-#: tell a malformed block from an old one)
+#: tell a malformed block from an old one) — the version token the
+#: artifact-schema catalog's ``loadgen_knee`` entry consumes
 BLOCK_VERSION = 1
 
-#: fields every rate step must carry for the artifact to curate
-STEP_FIELDS = ("rate_qps", "offered", "ok", "achieved_qps",
-               "shed_fraction", "within_slo")
+
+def _step_fields():
+    from knn_tpu.analysis.artifacts import element_required
+
+    return element_required("loadgen_knee", "rate_steps")
+
+
+#: fields every rate step must carry for the artifact to curate —
+#: DERIVED from the artifact-schema catalog (knn_tpu.analysis.
+#: artifacts), the one declaration the validator, refresher, and
+#: artifact-lockstep checker all read
+STEP_FIELDS = _step_fields()
 
 
 def run_step(target, spec: WorkloadSpec, *, queries,
@@ -138,39 +148,12 @@ def validate_knee_block(block) -> List[str]:
     curating a line carrying a ``loadgen_knee`` block: returns the
     list of violations (empty = valid).  Blocks that recorded their
     own failure (an ``error`` key) are exempt — an honest error field
-    beats a refused line."""
-    errs: List[str] = []
-    if not isinstance(block, dict):
-        return [f"knee block must be a dict, got {type(block).__name__}"]
-    if "error" in block:
-        return errs
-    if block.get("version") != BLOCK_VERSION:
-        errs.append(f"version must be {BLOCK_VERSION}, got "
-                    f"{block.get('version')!r}")
-    if not isinstance(block.get("slo_p99_ms"), (int, float)) \
-            or block.get("slo_p99_ms", 0) <= 0:
-        errs.append(f"slo_p99_ms must be a positive number, got "
-                    f"{block.get('slo_p99_ms')!r}")
-    steps = block.get("rate_steps")
-    if not isinstance(steps, list) or not steps:
-        errs.append("rate_steps must be a non-empty list")
-        steps = []
-    for i, s in enumerate(steps):
-        if not isinstance(s, dict):
-            errs.append(f"rate_steps[{i}] must be a dict")
-            continue
-        for fld in STEP_FIELDS:
-            if fld not in s:
-                errs.append(f"rate_steps[{i}] missing {fld!r}")
-    knee = block.get("knee_qps")
-    if knee is not None and not isinstance(knee, (int, float)):
-        errs.append(f"knee_qps must be a number or null, got {knee!r}")
-    if knee is not None and steps:
-        ok_steps = [s for s in steps if isinstance(s, dict)
-                    and s.get("within_slo")]
-        if not ok_steps:
-            errs.append("knee_qps set but no step is within_slo")
-    return errs
+    beats a refused line.  A shim over the artifact-schema catalog
+    (:mod:`knn_tpu.analysis.artifacts`, the ``loadgen_knee`` entry)
+    with the legacy error strings byte-identical."""
+    from knn_tpu.analysis.artifacts import validate
+
+    return validate("loadgen_knee", block, style="legacy")
 
 
 def closed_loop_anchor(queue, pool, *, requests: int = 32,

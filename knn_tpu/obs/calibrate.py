@@ -419,60 +419,27 @@ def validate_calibration(cal) -> List[str]:
     refresher refuses malformed ones; ``perf_sentinel --lint`` sweeps
     history with this).  Returns error strings, empty when
     well-formed.  An absent overlay must still be EXPLICIT: the field
-    is a dict with ``applied: false``, never missing-and-implied."""
-    errors: List[str] = []
-    if not isinstance(cal, dict):
-        return [f"calibration is {type(cal).__name__}, not dict"]
-    applied = cal.get("applied")
-    if not isinstance(applied, bool):
-        errors.append(f"calibration.applied {applied!r} is not a bool")
-        return errors
-    if not applied:
-        return errors
-    factors = cal.get("factors")
-    if not isinstance(factors, dict):
-        errors.append("applied calibration missing factors dict")
-    else:
-        for t in TERMS:
-            f = factors.get(t)
-            if not isinstance(f, (int, float)) or f <= 0:
-                errors.append(
-                    f"calibration factor {t} {f!r} is not a positive "
-                    f"number")
-    if cal.get("source") not in SOURCES:
-        errors.append(f"calibration source {cal.get('source')!r} not "
-                      f"in {SOURCES}")
-    res = cal.get("model_residual_pct")
-    if not isinstance(res, (int, float)):
-        errors.append(
-            f"calibration.model_residual_pct {res!r} is not a number")
-    return errors
+    is a dict with ``applied: false``, never missing-and-implied.
+    A compat shim over the artifact-schema catalog
+    (:mod:`knn_tpu.analysis.artifacts`, the ``calibration`` entry):
+    the engine's canonical phrasing is normalized, this entry point
+    keeps the historical strings so postmortem/doctor renderings stay
+    stable."""
+    from knn_tpu.analysis.artifacts import validate
+
+    return validate("calibration", cal, style="legacy")
 
 
 def validate_campaign_block(block) -> List[str]:
     """Structural validation of a bench/curated line's ``campaign``
     block (written by ``cli campaign``) — the refusal surface
     ``refresh_bench_artifacts.py`` applies so a malformed campaign
-    artifact can never enter the curated history."""
-    errors: List[str] = []
-    if not isinstance(block, dict):
-        return [f"campaign block is {type(block).__name__}, not dict"]
-    if not isinstance(block.get("campaign_version"), int):
-        errors.append("missing/non-int campaign_version")
-    if not block.get("arm"):
-        errors.append("missing arm name")
-    stages = block.get("stages")
-    if not isinstance(stages, list) or not stages:
-        errors.append("missing stages list")
-    else:
-        for s in stages:
-            if not isinstance(s, dict) or not s.get("stage") or \
-                    s.get("status") not in ("ok", "error", "skipped"):
-                errors.append(f"malformed stage record {s!r}")
-                break
-    if not isinstance(block.get("rehearse"), bool):
-        errors.append("missing/non-bool rehearse flag")
-    return errors
+    artifact can never enter the curated history.  A compat shim over
+    the artifact-schema catalog (the ``campaign`` entry), historical
+    strings preserved like :func:`validate_calibration`."""
+    from knn_tpu.analysis.artifacts import validate
+
+    return validate("campaign", block, style="legacy")
 
 
 def reset() -> None:

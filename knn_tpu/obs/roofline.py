@@ -683,64 +683,13 @@ def validate_block(block) -> list:
     """Structural validation of a ``roofline`` block (bench lines,
     curated artifacts, cache entries).  Returns a list of error
     strings, empty when well-formed — the refresher refuses malformed
-    blocks and ``perf_sentinel --lint`` sweeps the history with this."""
-    errors = []
-    if not isinstance(block, dict):
-        return [f"roofline block is {type(block).__name__}, not dict"]
-    if not isinstance(block.get("model_version"), int):
-        errors.append("missing/non-int model_version")
-    if block.get("bound_class") not in BOUND_CLASSES:
-        errors.append(f"bound_class {block.get('bound_class')!r} not in "
-                      f"{BOUND_CLASSES}")
-    ceil = block.get("ceiling_qps")
-    if not isinstance(ceil, (int, float)) or ceil <= 0:
-        errors.append(f"ceiling_qps {ceil!r} is not a positive number")
-    pct = block.get("roofline_pct")
-    if pct is not None and not isinstance(pct, (int, float)):
-        errors.append(f"roofline_pct {pct!r} is neither null nor a number")
-    terms = block.get("terms")
-    if not isinstance(terms, dict):
-        errors.append("missing terms breakdown")
-    else:
-        for term in ("hbm", "mxu", "vpu_select"):
-            t = terms.get(term)
-            if not isinstance(t, dict) or \
-                    not isinstance(t.get("time_s"), (int, float)) or \
-                    t["time_s"] < 0:
-                errors.append(f"terms.{term}.time_s missing or negative")
-        dcn = terms.get("dcn")
-        if dcn is not None:
-            # the MODEL_VERSION-4 cross-host merge term: present only
-            # on multi-host blocks, and then every field must hold —
-            # a malformed DCN claim would poison curated baselines
-            from knn_tpu.parallel.crossover import STRATEGIES
+    blocks and ``perf_sentinel --lint`` sweeps the history with this.
+    A shim over the artifact-schema catalog
+    (:mod:`knn_tpu.analysis.artifacts`, the ``roofline`` entry) with
+    the legacy error strings byte-identical."""
+    from knn_tpu.analysis.artifacts import validate
 
-            if not isinstance(dcn, dict):
-                errors.append("terms.dcn is not a dict")
-            else:
-                if not isinstance(dcn.get("time_s"), (int, float)) or \
-                        dcn["time_s"] < 0:
-                    errors.append("terms.dcn.time_s missing or negative")
-                if not isinstance(dcn.get("bytes"), int) or \
-                        dcn["bytes"] < 0:
-                    errors.append("terms.dcn.bytes missing or negative")
-                if not isinstance(dcn.get("hosts"), int) or \
-                        dcn["hosts"] < 2:
-                    errors.append("terms.dcn.hosts must be an int >= 2")
-                if dcn.get("strategy") not in STRATEGIES:
-                    errors.append(
-                        f"terms.dcn.strategy {dcn.get('strategy')!r} "
-                        f"not in {STRATEGIES}")
-    # MODEL_VERSION 3 blocks carry an explicit calibration verdict;
-    # pre-calibration history blocks (v1/v2) legitimately lack it, but
-    # one that IS present must be well-formed — a malformed overlay
-    # claim would poison the model_residual_pct baselines silently
-    if "calibration" in block:
-        from knn_tpu.obs import calibrate
-
-        errors.extend(calibrate.validate_calibration(
-            block["calibration"]))
-    return errors
+    return validate("roofline", block, style="legacy")
 
 
 def config_label(n: int, d: int, k: int, *, metric: str = "l2",

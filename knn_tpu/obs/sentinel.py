@@ -71,40 +71,29 @@ REGRESS_CEIL = 0.40
 #: normal-consistency constant: sigma = MAD_SCALE * MAD
 MAD_SCALE = 1.4826
 
-#: the curated fields a baseline tracks, with their good direction
-#: (all current fields are higher-is-better throughput/utilization).
+def _curated_fields() -> Tuple[Tuple[str, str], ...]:
+    from knn_tpu.analysis.artifacts import curated_fields
+
+    return curated_fields()
+
+
+#: the curated fields a baseline tracks, with their good direction —
+#: DERIVED from the artifact-schema catalog (knn_tpu.analysis.
+#: artifacts: each block's schema declares its curated contribution;
+#: the hand-maintained list is gone, and the ``artifact-lockstep``
+#: checker fails the lint if this derivation is ever removed).
 #: ``roofline_pct`` is the model-anchored family: where the raw-qps
 #: fields judge a line against its own HISTORY, percent-of-roofline
 #: judges it against the hardware ceiling the cost model predicts for
 #: its exact config (knn_tpu.obs.roofline) — a geometry change that
 #: legitimately lowers qps but holds its roofline fraction reads ok,
 #: and a same-config run that slides down the ceiling reads as the
-#: regression it is.
-CURATED_FIELDS: Tuple[Tuple[str, str], ...] = (
-    ("value", "higher"),
-    ("device_phase_qps", "higher"),
-    ("serving_sustained_qps", "higher"),
-    ("mfu", "higher"),
-    ("mfu_device", "higher"),
-    ("roofline_pct", "higher"),
-    # the measured latency-vs-throughput knee (knn_tpu.loadgen.knee):
-    # the max sustained request rate whose admitted p99 met the SLO —
-    # a knee that slides down is a serving regression even when the
-    # closed-loop headline holds
-    ("knee_qps", "higher"),
-    # calibration drift (knn_tpu.obs.calibrate): |percent| the ANALYTIC
-    # roofline mispredicted the measured device time by, judged
-    # lower-is-better on the magnitude — a residual that GROWS across
-    # rounds means the model (or the machine) moved and the calibration
-    # campaign must re-run; curated_value takes the abs so a sign flip
-    # around zero never reads as an improvement
-    ("model_residual_pct", "lower"),
-    # the mixed-traffic admitted-read p99 (knn_tpu.index, bench's
-    # mutation mode): the live-mutation serving tail across compaction
-    # swaps, judged lower-is-better — a p99 that climbs across rounds
-    # means swaps (or the delta tail) started stalling readers
-    ("mutation_admitted_p99_ms", "lower"),
-)
+#: regression it is.  ``knee_qps`` (loadgen) is higher-is-better like
+#: the throughput family; ``model_residual_pct`` (calibration drift)
+#: and ``mutation_admitted_p99_ms`` (the live-mutation serving tail)
+#: judge lower-is-better — curated_value() takes the residual's abs so
+#: a sign flip around zero never reads as an improvement.
+CURATED_FIELDS: Tuple[Tuple[str, str], ...] = _curated_fields()
 
 
 def curated_value(rec: dict, fname: str):

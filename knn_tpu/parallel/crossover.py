@@ -124,55 +124,13 @@ def validate_multihost_block(block) -> list:
     """Structural validation of a ``multihost`` bench block.  Returns a
     list of error strings, empty when well-formed — the artifact
     refresher REFUSES malformed blocks (the roofline/knee discipline:
-    a corrupt block would poison curated baselines silently)."""
-    errors = []
-    if not isinstance(block, dict):
-        return [f"multihost block is {type(block).__name__}, not dict"]
-    hosts = block.get("hosts")
-    if not isinstance(hosts, int) or hosts < 1:
-        errors.append(f"hosts {hosts!r} is not a positive int")
-    chips = block.get("chips_per_host")
-    if chips is not None and (not isinstance(chips, int) or chips < 1):
-        errors.append(f"chips_per_host {chips!r} is not a positive int")
-    merge = block.get("merge")
-    if not isinstance(merge, dict):
-        errors.append("missing merge breakdown")
-    else:
-        for level in ("intra", "dcn"):
-            rec = merge.get(level)
-            if rec is None:
-                continue
-            if not isinstance(rec, dict):
-                errors.append(f"merge.{level} is not a dict")
-                continue
-            if rec.get("strategy") not in STRATEGIES:
-                errors.append(
-                    f"merge.{level}.strategy {rec.get('strategy')!r} "
-                    f"not in {STRATEGIES}")
-            if rec.get("source") not in SOURCES:
-                errors.append(
-                    f"merge.{level}.source {rec.get('source')!r} "
-                    f"not in {SOURCES}")
-    db = block.get("dcn_merge_bytes")
-    if db is not None and (not isinstance(db, int) or db < 0):
-        errors.append(f"dcn_merge_bytes {db!r} is not a non-negative int")
-    ht = block.get("hosttier")
-    if ht is not None:
-        if not isinstance(ht, dict):
-            errors.append("hosttier is not a dict")
-        else:
-            sw = ht.get("sweeps")
-            if not isinstance(sw, int) or sw < 1:
-                errors.append(f"hosttier.sweeps {sw!r} is not a positive int")
-            bb = ht.get("budget_bytes")
-            if not isinstance(bb, int) or bb <= 0:
-                errors.append(
-                    f"hosttier.budget_bytes {bb!r} is not a positive int")
-            sr = ht.get("segment_rows")
-            if not isinstance(sr, int) or sr < 1:
-                errors.append(
-                    f"hosttier.segment_rows {sr!r} is not a positive int")
-    return errors
+    a corrupt block would poison curated baselines silently).  A shim
+    over the artifact-schema catalog (:mod:`knn_tpu.analysis.
+    artifacts`, the ``multihost`` entry) with the legacy error strings
+    byte-identical."""
+    from knn_tpu.analysis.artifacts import validate
+
+    return validate("multihost", block, style="legacy")
 
 
 __all__ = [

@@ -7,16 +7,18 @@ Three modes, all reading the repo's recorded bench history
 ``--lint``
     CI config validation: the SLO objectives (defaults or
     ``KNN_TPU_SLO_CONFIG``) parse and reference only cataloged metrics,
-    the bench history parses into baselines, and every ``roofline`` /
-    ``calibration`` / ``campaign`` / ``loadgen_knee`` block a history
-    line carries is structurally valid
-    (knn_tpu.obs.roofline.validate_block,
-    knn_tpu.obs.calibrate.validate_calibration /
-    validate_campaign_block, knn_tpu.loadgen.knee.validate_knee_block —
-    a malformed block would poison the roofline_pct /
-    model_residual_pct / knee_qps baselines silently).  This is what
-    ``scripts/check_tier1.sh --fast`` runs — a broken SLO config or a
-    corrupted history fixture fails here, not at serve time.
+    the bench history parses into baselines, and every block in every
+    checked-in ``TPU_BENCH_r*.jsonl`` / ``BENCH_r*.json`` /
+    ``MULTICHIP_r*.json`` line — roofline, calibration, campaign,
+    loadgen_knee, mutation, multihost, the sentinel verdict, the bench
+    line's own top-level fields — is validated against the
+    artifact-schema catalog (knn_tpu.analysis.artifacts), with
+    exact-version schemas exempting blocks from pre-schema rounds and
+    the per-family counts printed (a malformed block would poison the
+    roofline_pct / model_residual_pct / knee_qps baselines silently).
+    This is what ``scripts/check_tier1.sh --fast`` runs — a broken SLO
+    config or a corrupted history fixture fails here, not at serve
+    time.
 
 ``--check-latest``
     Judge the NEWEST curated round's lines against baselines built from
@@ -72,99 +74,72 @@ def run_lint(repo) -> int:
     except Exception as e:  # noqa: BLE001
         errors.append(f"bench history: {type(e).__name__}: {e}")
         records = []
+    # the catalog-driven history sweep (knn_tpu.analysis.artifacts):
+    # every cataloged block on every history line — roofline,
+    # calibration, campaign, loadgen_knee, mutation, multihost, the
+    # sentinel verdict, the bench line's own top-level fields — plus
+    # every MULTICHIP_r*.json driver record, validated against the
+    # artifact-schema catalog.  Exact-version schemas exempt blocks
+    # stamped with a strictly older version token (pre-schema rounds
+    # are counted, not condemned); bench's advisory {"error": ...}
+    # degradation blocks are a designed outcome, the refresher's own
+    # carve-out.  A malformed block would poison the roofline_pct /
+    # model_residual_pct / knee_qps baselines silently — it fails CI
+    # here instead.
     try:
-        from knn_tpu.obs import roofline
+        from knn_tpu.analysis import artifacts
 
-        n_blocks, n_errored = 0, 0
-        for rec in records:
-            block = rec.get("roofline")
-            if block is None:
-                continue
-            if isinstance(block, dict) and "error" in block:
-                # bench's advisory degradation (a model gap recorded as
-                # {"error": ...}) is a designed outcome, not a lint hit
-                # — the same carve-out the artifact refresher applies
-                n_errored += 1
-                continue
-            n_blocks += 1
-            for err in roofline.validate_block(block):
-                errors.append(
-                    f"roofline block on {rec.get('metric')} "
-                    f"({rec.get('_source')}): {err}")
-        print(f"roofline blocks: OK ({n_blocks} validated, "
-              f"{n_errored} advisory-error blocks skipped)")
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"roofline blocks: {type(e).__name__}: {e}")
-    try:
-        from knn_tpu.obs import calibrate
+        counts, problems = artifacts.sweep_records(records)
+        for p in problems:
+            errors.append(f"{p['label']} block on {p['metric']} "
+                          f"({p['source']}): {p['error']}")
+        mc_n, mc_problems = artifacts.sweep_multichip(repo)
+        for p in mc_problems:
+            errors.append(f"{p['label']} record {p['source']}: "
+                          f"{p['error']}")
 
-        n_cal, n_camp, n_before = 0, 0, len(errors)
-        for rec in records:
-            block = rec.get("roofline")
-            cal = block.get("calibration") if isinstance(block, dict) \
-                else None
-            if cal is not None and "error" not in block:
-                n_cal += 1
-                for err in calibrate.validate_calibration(cal):
-                    errors.append(
-                        f"calibration block on {rec.get('metric')} "
-                        f"({rec.get('_source')}): {err}")
-            camp = rec.get("campaign")
-            if camp is not None:
-                n_camp += 1
-                for err in calibrate.validate_campaign_block(camp):
-                    errors.append(
-                        f"campaign block on {rec.get('metric')} "
-                        f"({rec.get('_source')}): {err}")
-        if len(errors) == n_before:
-            print(f"calibration blocks: OK ({n_cal} calibration, "
-                  f"{n_camp} campaign validated)")
+        def _c(name, key="validated"):
+            return counts.get(name, {}).get(key, 0)
+
+        def _exempt(name):
+            n = counts.get(name, {}).get("version_exempt", 0)
+            return f", {n} version-exempt" if n else ""
+
+        rl_viol = sum(1 for p in problems if p["schema"] == "roofline")
+        if not rl_viol:
+            print(f"roofline blocks: OK ({_c('roofline')} validated, "
+                  f"{_c('roofline', 'advisory_error')} advisory-error "
+                  f"blocks skipped)")
         else:
-            print(f"calibration blocks: "
-                  f"{len(errors) - n_before} violation(s) across "
-                  f"{n_cal + n_camp} blocks")
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"calibration blocks: {type(e).__name__}: {e}")
-    try:
-        from knn_tpu.loadgen.knee import validate_knee_block
-
-        n_knee, n_before = 0, len(errors)
-        for rec in records:
-            block = rec.get("loadgen_knee")
-            if block is None:
-                continue
-            n_knee += 1
-            for err in validate_knee_block(block):
-                errors.append(
-                    f"loadgen_knee block on {rec.get('metric')} "
-                    f"({rec.get('_source')}): {err}")
-        if len(errors) == n_before:
-            print(f"knee blocks: OK ({n_knee} validated)")
+            print(f"roofline blocks: {rl_viol} violation(s) across "
+                  f"{_c('roofline')} blocks")
+        cal_viol = sum(1 for p in problems
+                       if p["schema"] in ("calibration", "campaign"))
+        if not cal_viol:
+            print(f"calibration blocks: OK ({_c('calibration')} "
+                  f"calibration, {_c('campaign')} campaign validated)")
         else:
-            print(f"knee blocks: {len(errors) - n_before} violation(s) "
-                  f"across {n_knee} blocks")
+            print(f"calibration blocks: {cal_viol} violation(s) across "
+                  f"{_c('calibration') + _c('campaign')} blocks")
+        for name, label in (("loadgen_knee", "knee"),
+                            ("mutation", "mutation"),
+                            ("multihost", "multihost"),
+                            ("sentinel", "sentinel verdict")):
+            viol = sum(1 for p in problems if p["schema"] == name)
+            if not viol:
+                print(f"{label} blocks: OK ({_c(name)} validated"
+                      f"{_exempt(name)})")
+            else:
+                print(f"{label} blocks: {viol} violation(s) across "
+                      f"{_c(name)} blocks")
+        line_viol = sum(1 for p in problems
+                        if p["schema"] == "bench_line")
+        print(f"bench lines: {_c('bench_line')} validated against the "
+              f"artifact-schema catalog"
+              + (f", {line_viol} violation(s)" if line_viol else "")
+              + f"; multichip records: {mc_n} validated")
     except Exception as e:  # noqa: BLE001
-        errors.append(f"knee blocks: {type(e).__name__}: {e}")
-    try:
-        from knn_tpu.index.artifact import validate_mutation_block
-
-        n_mut, n_before = 0, len(errors)
-        for rec in records:
-            block = rec.get("mutation")
-            if block is None:
-                continue
-            n_mut += 1
-            for err in validate_mutation_block(block):
-                errors.append(
-                    f"mutation block on {rec.get('metric')} "
-                    f"({rec.get('_source')}): {err}")
-        if len(errors) == n_before:
-            print(f"mutation blocks: OK ({n_mut} validated)")
-        else:
-            print(f"mutation blocks: {len(errors) - n_before} "
-                  f"violation(s) across {n_mut} blocks")
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"mutation blocks: {type(e).__name__}: {e}")
+        errors.append(f"artifact sweep: {type(e).__name__}: {e}")
     for err in errors:
         print(f"perf_sentinel --lint: {err}", file=sys.stderr)
     return 1 if errors else 0

@@ -22,16 +22,20 @@ GloVe/GIST republishing round-3 numbers verbatim with no marker; this
 script REFUSES to write any line missing the fields, so an unmarked
 republication can never happen again.
 
-Fresh lines additionally carry a roofline attribution
-(knn_tpu.obs.roofline): the bench-embedded block validated (malformed
-blocks REFUSED), pre-roofline lines back-derived from their own config
-fields, and ``roofline_pct``/``bound_class`` hoisted top-level for the
-sentinel's baselines; the per-line print shows the percent and bound
-class beside the sentinel verdict.  MODEL_VERSION-3 lines carrying a
-``calibration`` verdict (or a ``campaign`` artifact block from
-``cli campaign``) are validated the same way — malformed ones REFUSED,
-``model_residual_pct`` hoisted, ``calib=RESIDUAL%`` printed beside the
-sentinel/roofline/knee readout."""
+Fresh lines are then curated TABLE-DRIVEN over the artifact-schema
+catalog (knn_tpu.analysis.artifacts): one validate/refuse/hoist/print
+loop covers every cataloged block — roofline (pre-roofline lines
+back-derived from their own config fields), calibration, campaign,
+loadgen_knee, mutation, multihost.  Malformed blocks are REFUSED (a
+corrupt block would silently poison the sentinel's curated-field
+baselines), each schema's declared hoist keys land top-level
+(``roofline_pct``/``bound_class``, ``model_residual_pct``,
+``knee_qps``, ``mutation_admitted_p99_ms``, ``multihost_hosts``/
+``multihost_merge``/``hosttier_sweeps``), and the per-line print shows
+each block's readout beside the sentinel verdict.  Adding a bench
+block is one schema entry in the catalog, not another stanza here;
+``cli lint``'s artifact-lockstep checker verifies this script still
+speaks the catalog."""
 import json
 import os
 import subprocess
@@ -173,164 +177,33 @@ for cfg, rec in best.items():
     # round and republished here must say so on its face
     rec["stale"] = rec["measured_round"] < _r
 
-# roofline curation (knn_tpu.obs.roofline): every fresh curated line
-# carries a percent-of-roofline attribution — the block the bench
-# embedded (REFUSED if malformed: a corrupt block would silently
-# poison the sentinel's roofline_pct baselines), or one derived from
-# the line's own config fields for lines measured before the in-bench
-# block existed — with roofline_pct hoisted top-level for the
-# sentinel's curated-field baselines.
+# artifact-block curation, table-driven over the artifact-schema
+# catalog (knn_tpu.analysis.artifacts): ONE validate/refuse/hoist loop
+# covers every cataloged block a fresh line carries — roofline (with
+# pre-roofline lines back-derived from their own config fields),
+# calibration, campaign, loadgen_knee, mutation, multihost — refusing
+# malformed blocks (a corrupt block would silently poison the
+# sentinel's curated-field baselines) and hoisting each schema's
+# declared top-level keys.  Adding a bench block is one schema entry,
+# not another copy of this stanza; the ``artifact-lockstep`` checker
+# (cli lint) machine-verifies this script still speaks the catalog.
 sys.path.insert(0, REPO)
+_line_summary = None
 try:
-    from knn_tpu.obs import roofline as _roofline
+    from knn_tpu.analysis import artifacts as _artifacts
 
-    for cfg, rec in best.items():
-        if rec["stale"]:
-            continue  # a republished number keeps its old block verbatim
-        block = rec.get("roofline")
-        if block is not None:
-            errs = _roofline.validate_block(block)
-            if errs and "error" not in block:
-                sys.exit(f"refusing to emit curated line for {cfg}: "
-                         f"malformed roofline block: {'; '.join(errs)}")
-        else:
-            block = _roofline.block_for_bench_line(rec)
-            if block is not None:
-                rec["roofline"] = dict(block, derived=True)
-        if isinstance(block, dict) and \
-                block.get("roofline_pct") is not None:
-            rec.setdefault("roofline_pct", block["roofline_pct"])
-            rec.setdefault("bound_class", block.get("bound_class"))
-except SystemExit:
-    raise
-except Exception as _e:  # noqa: BLE001 — curation must never fail on it
-    print(f"roofline curation skipped: {type(_e).__name__}: {_e}",
-          file=sys.stderr)
-
-# calibration + campaign curation (knn_tpu.obs.calibrate): a fresh
-# line's roofline block carrying a `calibration` verdict is validated
-# (malformed blocks REFUSED — a corrupt overlay claim would poison the
-# model_residual_pct baselines and let a line silently claim
-# calibrated), with the signed residual hoisted top-level for the
-# sentinel; a `campaign` artifact block (cli campaign) is REFUSED when
-# malformed, same discipline.
-try:
-    from knn_tpu.obs import calibrate as _calibrate
-
+    _line_summary = _artifacts.line_summary
     for cfg, rec in best.items():
         if rec["stale"]:
             continue  # a republished number keeps its old blocks verbatim
-        block = rec.get("roofline")
-        cal = block.get("calibration") if isinstance(block, dict) \
-            else None
-        if cal is not None and "error" not in block:
-            errs = _calibrate.validate_calibration(cal)
-            if errs:
-                sys.exit(f"refusing to emit curated line for {cfg}: "
-                         f"malformed calibration block: "
-                         f"{'; '.join(errs)}")
-            if cal.get("applied") and isinstance(
-                    cal.get("model_residual_pct"), (int, float)):
-                rec.setdefault("model_residual_pct",
-                               cal["model_residual_pct"])
-        camp = rec.get("campaign")
-        if camp is not None:
-            errs = _calibrate.validate_campaign_block(camp)
-            if errs:
-                sys.exit(f"refusing to emit curated line for {cfg}: "
-                         f"malformed campaign block: {'; '.join(errs)}")
-except SystemExit:
-    raise
-except Exception as _e:  # noqa: BLE001 — curation must never fail on it
-    print(f"calibration curation skipped: {type(_e).__name__}: {_e}",
-          file=sys.stderr)
-
-# knee curation (knn_tpu.loadgen.knee): a fresh line carrying a
-# loadgen_knee block (bench's knee mode / cli loadgen) is validated —
-# malformed blocks REFUSED, the roofline discipline: a corrupt block
-# would silently poison the sentinel's knee_qps baselines — and
-# knee_qps hoisted top-level for the curated-field baselines.
-try:
-    from knn_tpu.loadgen.knee import validate_knee_block as _vkb
-
-    for cfg, rec in best.items():
-        if rec["stale"]:
-            continue  # a republished number keeps its old block verbatim
-        block = rec.get("loadgen_knee")
-        if block is None:
-            continue
-        errs = _vkb(block)
-        if errs:
+        refusal = _artifacts.curate_line(rec)
+        if refusal:
             sys.exit(f"refusing to emit curated line for {cfg}: "
-                     f"malformed loadgen_knee block: {'; '.join(errs)}")
-        if block.get("knee_qps") is not None:
-            rec.setdefault("knee_qps", block["knee_qps"])
+                     f"{refusal}")
 except SystemExit:
     raise
 except Exception as _e:  # noqa: BLE001 — curation must never fail on it
-    print(f"knee curation skipped: {type(_e).__name__}: {_e}",
-          file=sys.stderr)
-
-# mutation curation (knn_tpu.index.artifact): a fresh line carrying a
-# `mutation` block (bench's opt-in mutation mode — mixed read+write
-# traffic across compaction swaps) is validated — malformed blocks
-# REFUSED, the roofline/knee discipline — with the admitted-read p99
-# hoisted top-level for the sentinel's lower-is-better baseline.
-try:
-    from knn_tpu.index.artifact import (
-        validate_mutation_block as _vmut,
-    )
-
-    for cfg, rec in best.items():
-        if rec["stale"]:
-            continue  # a republished number keeps its old block verbatim
-        block = rec.get("mutation")
-        if block is None:
-            continue
-        errs = _vmut(block)
-        if errs:
-            sys.exit(f"refusing to emit curated line for {cfg}: "
-                     f"malformed mutation block: {'; '.join(errs)}")
-        if block.get("admitted_p99_ms") is not None:
-            rec.setdefault("mutation_admitted_p99_ms",
-                           block["admitted_p99_ms"])
-except SystemExit:
-    raise
-except Exception as _e:  # noqa: BLE001 — curation must never fail on it
-    print(f"mutation curation skipped: {type(_e).__name__}: {_e}",
-          file=sys.stderr)
-
-# multihost curation (knn_tpu.parallel.crossover): a fresh line
-# carrying a `multihost` block (bench's multihost mode — hierarchical
-# merge + host-RAM tier) is validated — malformed blocks REFUSED, the
-# roofline/knee discipline — with the merge strategy, host count, and
-# host-tier sweep count hoisted top-level for the curated summary.
-try:
-    from knn_tpu.parallel.crossover import (
-        validate_multihost_block as _vmh,
-    )
-
-    for cfg, rec in best.items():
-        if rec["stale"]:
-            continue  # a republished number keeps its old block verbatim
-        block = rec.get("multihost")
-        if block is None:
-            continue
-        errs = _vmh(block)
-        if errs:
-            sys.exit(f"refusing to emit curated line for {cfg}: "
-                     f"malformed multihost block: {'; '.join(errs)}")
-        rec.setdefault("multihost_hosts", block["hosts"])
-        dcn = (block.get("merge") or {}).get("dcn") or {}
-        if dcn.get("strategy"):
-            rec.setdefault("multihost_merge", dcn["strategy"])
-        ht = block.get("hosttier") or {}
-        if ht.get("sweeps"):
-            rec.setdefault("hosttier_sweeps", ht["sweeps"])
-except SystemExit:
-    raise
-except Exception as _e:  # noqa: BLE001 — curation must never fail on it
-    print(f"multihost curation skipped: {type(_e).__name__}: {_e}",
+    print(f"artifact curation skipped: {type(_e).__name__}: {_e}",
           file=sys.stderr)
 
 # perf-regression sentinel (knn_tpu.obs.sentinel): every curated line
@@ -367,34 +240,9 @@ with open(DST, "w") as f:
                  if "obs_overhead_pct" in r else "")
               + (f" sentinel={r['sentinel']['verdict']}"
                  if "sentinel" in r else "")
-              # percent-of-roofline + bound class beside the sentinel
-              # verdict: the history says "slower than before", the
-              # model says "this far from the hardware, bound by THIS"
-              + (f" roofline={r['roofline_pct'] * 100:.1f}%"
-                 f"/{r.get('bound_class')}"
-                 if isinstance(r.get("roofline_pct"), (int, float))
-                 else "")
-              # the analytic model's measured residual, when the line's
-              # roofline block carries an applied calibration overlay
-              + (f" calib={r['model_residual_pct']}%"
-                 if isinstance(r.get("model_residual_pct"),
-                               (int, float)) else "")
-              # the measured serving knee (loadgen sweep), when the
-              # session ran one: max SLO-meeting sustained request rate
-              + (f" knee={r['knee_qps']}q/s"
-                 if isinstance(r.get("knee_qps"), (int, float)) else "")
-              # the mixed-traffic admitted-read p99 (mutation mode),
-              # when the session ran one: the live-mutation tail beside
-              # the read-only numbers
-              + (f" mutation={r['mutation_admitted_p99_ms']}ms/p99"
-                 if isinstance(r.get("mutation_admitted_p99_ms"),
-                               (int, float)) else "")
-              # the multi-host topology measurement, when the session
-              # ran one: host count x DCN merge strategy + host-RAM
-              # tier sweep count
-              + (f" multihost={r['multihost_hosts']}x"
-                 f"{r.get('multihost_merge')}"
-                 + (f"/{r['hosttier_sweeps']}sweeps"
-                    if isinstance(r.get("hosttier_sweeps"), int) else "")
-                 if isinstance(r.get("multihost_hosts"), int) else "")
+              # the per-block artifact readout (roofline percent/bound,
+              # calibration residual, knee, mutation p99, multihost
+              # topology), one segment per cataloged block, driven by
+              # the artifact-schema catalog's print table
+              + (_line_summary(r) if _line_summary is not None else "")
               + (" STALE" if r["stale"] else ""))

@@ -46,10 +46,10 @@ def run_on(root, checker):
 
 
 # --- framework ----------------------------------------------------------
-def test_registry_has_the_five_checkers():
+def test_registry_has_the_six_checkers():
     assert set(CHECKERS) == {"switch-lockstep", "metric-lockstep",
                              "locked-mutation", "jax-hygiene",
-                             "vmem-budget"}
+                             "vmem-budget", "artifact-lockstep"}
 
 
 def test_unknown_checker_raises():
