@@ -40,7 +40,8 @@ honest denominator.
 Env overrides:
   KNN_BENCH_CONFIG   sift1m (default) | glove | gist1m   (BASELINE configs 3/4/5)
   KNN_BENCH_MODES    comma list from {exact,certified_approx,
-                     certified_pallas,serving,knee,multihost,mutation}
+                     certified_pallas,serving,knee,multihost,mutation,
+                     ivf}
   KNN_BENCH_RUNS     timed repetitions per mode (default 5)
   KNN_BENCH_N, KNN_BENCH_DIM, KNN_BENCH_K, KNN_BENCH_NQ, KNN_BENCH_BATCH,
   KNN_BENCH_TILE, KNN_BENCH_CPU_QUERIES, KNN_BENCH_MARGIN,
@@ -1003,6 +1004,73 @@ def main() -> None:
         return {"mutation": block,
                 "mutation_admitted_p99_ms": lat.get("p99")}
 
+    def sweep_ivf():
+        """Opt-in IVF tier measurement (knn_tpu.ivf): train the
+        list-major placement, run the certified probed search over the
+        full query set, and emit the validated ``ivf`` artifact block —
+        recall_at_k / probe_fraction / fallback_rate /
+        bytes_streamed_ratio beside the probed qps.  Every run also
+        re-asserts the exactness anchor on a sub-batch: the
+        nprobe=ncentroids arm must reproduce exact brute force bitwise,
+        or the block carries the mismatch as its error instead of a
+        lying rate.  ncentroids/nprobe come from the KNN_TPU_IVF_*
+        switch family (index defaults: round(sqrt(n)), ncentroids/4)."""
+        from knn_tpu.ivf import IVFIndex
+        from knn_tpu.ivf.artifact import IVF_VERSION, validate_ivf_block
+        from knn_tpu.ops.refine import refine_shared_exact
+
+        # cap the trained placement like mutation mode: this line
+        # measures the pruning tradeoff, not raw scan throughput
+        n_idx = min(N, 131072)
+        idx = IVFIndex(db[:n_idx], mesh=mesh, k=K, metric="l2",
+                       train_tile=tile)
+        ist = idx.stats()
+        idx.search_certified(queries[:BATCH])  # warm/compile off-clock
+        times = []
+        stats = None
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            _, _, stats = idx.search_certified(queries)
+            times.append(time.perf_counter() - t0)
+        qps = round(NQ / float(np.mean(times)), 2)
+        anchor_err = None
+        try:
+            aq = queries[: min(BATCH, 256)]
+            d_all, i_all, _ = idx.search_certified(
+                aq, nprobe=ist["ncentroids"])
+            d_ref, i_ref = refine_shared_exact(
+                db[:n_idx], aq, np.arange(n_idx, dtype=np.int64), K)
+            if not (np.array_equal(i_all, i_ref)
+                    and np.array_equal(d_all, d_ref)):
+                anchor_err = ("exactness anchor: nprobe=ncentroids "
+                              "!= brute force bitwise")
+        except Exception as e:  # noqa: BLE001 — recorded, never fatal
+            anchor_err = f"exactness anchor: {type(e).__name__}: {e}"
+        block = {
+            "ivf_version": IVF_VERSION,
+            "ncentroids": int(stats["ncentroids"]),
+            "nprobe": int(stats["nprobe"]),
+            "queries": int(stats["queries"]),
+            "k": int(stats["k"]),
+            "probe_fraction": stats["probe_fraction"],
+            "recall_at_k": stats["recall_at_k"],
+            "fallback_rate": stats["fallback_rate"],
+            "bytes_streamed_ratio": stats["bytes_streamed_ratio"],
+            "qps": qps,
+            "selector": stats["selector"],
+            "fallback_queries": int(stats["fallback_queries"]),
+            "certified_queries": int(stats["certified_queries"]),
+            "genuine_misses": int(stats["genuine_misses"]),
+            "epoch": int(ist["epoch"]),
+            "compactions": int(ist["compactions"]),
+        }
+        if anchor_err:
+            block["error"] = anchor_err
+        errs = validate_ivf_block(block)
+        if errs:
+            block["validation_errors"] = errs
+        return {"ivf": block}
+
     def sweep_multihost():
         """Multi-host serving measurement, two arms on one line:
 
@@ -1432,6 +1500,15 @@ def main() -> None:
                 entry = {"error": f"{type(e).__name__}: {e}"}
             results[mode] = entry
             continue
+        if mode == "ivf":
+            # probed-tier tradeoff measurement (bytes saved vs fallback
+            # repairs): a pruning-shape line, never a headline competitor
+            try:
+                entry = sweep_ivf()
+            except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+                entry = {"error": f"{type(e).__name__}: {e}"}
+            results[mode] = entry
+            continue
         if mode == "multihost":
             # hierarchical-merge + host-RAM tier measurement: a
             # topology-shape line, never a headline-number competitor
@@ -1647,6 +1724,10 @@ def main() -> None:
         # block on the line, admitted p99 hoisted below
         **({"mutation": results["mutation"]["mutation"]}
            if results.get("mutation", {}).get("mutation") else {}),
+        # the probed-tier tradeoff (opt-in ivf mode): block on the
+        # line; ivf_qps + recall hoist via the catalog loop below
+        **({"ivf": results["ivf"]["ivf"]}
+           if results.get("ivf", {}).get("ivf") else {}),
         # the multi-host topology measurement (opt-in multihost mode):
         # block + the mode entry's own qps (not a block field); the
         # host-tier sweep count hoists below

@@ -673,6 +673,17 @@ def _summary_mutation(r: dict) -> str:
     return ""
 
 
+def _summary_ivf(r: dict) -> str:
+    # the probe-pruned tier (ivf mode), when the session ran one:
+    # certified qps beside the measured recall the certificate gates
+    if isinstance(r.get("ivf_qps"), (int, float)):
+        seg = f" ivf={r['ivf_qps']}q/s"
+        if isinstance(r.get("recall_at_k"), (int, float)):
+            seg += f"@recall{r['recall_at_k']}"
+        return seg
+    return ""
+
+
 def _summary_multihost(r: dict) -> str:
     # the multi-host topology measurement, when the session ran one:
     # host count x DCN merge strategy + host-RAM tier sweep count
@@ -689,6 +700,7 @@ _SUMMARIES = {
     "calibration": _summary_calibration,
     "knee": _summary_knee,
     "mutation": _summary_mutation,
+    "ivf": _summary_ivf,
     "multihost": _summary_multihost,
 }
 
@@ -856,6 +868,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("roofline", "any"),
             Field("loadgen_knee", "any"),
             Field("mutation", "any"),
+            Field("ivf", "any"),
             Field("multihost", "any"),
             Field("campaign", "any"),
             Field("sentinel", "any"),
@@ -867,6 +880,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("model_residual_pct", "number", nullable=True),
             Field("knee_qps", "number", nullable=True),
             Field("mutation_admitted_p99_ms", "number", nullable=True),
+            Field("ivf_qps", "number", nullable=True),
             Field("multihost_hosts", "int", nullable=True),
             Field("multihost_merge", "str", nullable=True),
             Field("multihost_qps", "number", nullable=True),
@@ -1218,6 +1232,76 @@ CATALOG: Tuple[BlockSchema, ...] = (
                             "machine-emitted"),
         ),
     ),
+    # --- ivf -------------------------------------------------------------
+    BlockSchema(
+        name="ivf",
+        block_path="ivf",
+        doc="docs/PERF.md#IVF tier & certified recall",
+        validator="knn_tpu.ivf.artifact:validate_ivf_block",
+        emitters=("bench.py",),
+        fingerprints=(frozenset({"ivf_version", "nprobe"}),),
+        version_field="ivf_version",
+        version_ref=Ref("knn_tpu.ivf.artifact", "IVF_VERSION"),
+        version_exact=True,
+        not_dict_legacy="ivf block must be a dict, got {vtype}",
+        error_exempt="validator",
+        refusal_label="ivf",
+        curate=True,
+        sweep=True,
+        summary="ivf",
+        missing_order=("ivf_version", "ncentroids", "nprobe", "queries",
+                       "k", "probe_fraction", "recall_at_k",
+                       "fallback_rate", "bytes_streamed_ratio", "qps"),
+        missing_legacy="missing {key!r}",
+        hoists=(Hoist("qps", "ivf_qps"),),
+        curated=(
+            Curated("recall_at_k", "higher", 9),
+            Curated("ivf_qps", "higher", 10),
+        ),
+        checks=(
+            Field("ivf_version", "version", required=True,
+                  legacy="ivf_version must be {version}, got "
+                         "{value!r}"),
+            Field("ncentroids", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("nprobe", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("queries", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("k", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("probe_fraction", "number", required=True, ge=0,
+                  le=1,
+                  legacy="{path} must be a number in [0, 1], got "
+                         "{value!r}"),
+            Field("recall_at_k", "number", required=True, ge=0, le=1,
+                  legacy="{path} must be a number in [0, 1], got "
+                         "{value!r}"),
+            Field("fallback_rate", "number", required=True, ge=0,
+                  le=1,
+                  legacy="{path} must be a number in [0, 1], got "
+                         "{value!r}"),
+            Field("bytes_streamed_ratio", "number", required=True,
+                  ge=0,
+                  legacy="{path} must be a non-negative number, got "
+                         "{value!r}"),
+            Field("qps", "number", required=True, nullable=True, ge=0,
+                  legacy="qps must be a non-negative number or null, "
+                         "got {value!r}"),
+            Field("selector", "any"),
+            Field("fallback_queries", "any"),
+            Field("certified_queries", "any"),
+            Field("genuine_misses", "any"),
+            Field("epoch", "any"),
+            Field("compactions", "any"),
+            Field("validation_errors", "any"),
+            Field("error", "any"),
+        ),
+    ),
     # --- multihost -------------------------------------------------------
     BlockSchema(
         name="multihost",
@@ -1328,6 +1412,11 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("measured_at", "str", nullable=True),
             Field("pruning", "dict", nullable=True),
             Field("vmem", "dict", nullable=True),
+            # the IVF autotuner's (autotune_ivf) entry rides the same
+            # shape: its per-candidate probe/fallback stats and the
+            # selector its searches ran under
+            Field("selector", "str", nullable=True),
+            Field("stats_per_candidate", "dict", nullable=True),
             Field("roofline", nested="roofline"),
             Field("roofline_pct", "number", nullable=True),
             Field("bound_class", "str", nullable=True),

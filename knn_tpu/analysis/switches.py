@@ -164,6 +164,22 @@ SWITCHES: Tuple[Switch, ...] = (
        "Background compactor period: fold pending writes in every "
        "this-many seconds even below the thresholds (unset = "
        "threshold-triggered only)."),
+    # --- IVF tier (knn_tpu.ivf.index) ----------------------------------
+    _s("KNN_TPU_IVF_", "family", "knn_tpu/ivf/index.py", _PERF,
+       "IVF-tier knob family (coarse quantizer + probe defaults); any "
+       "ambient member is scrubbed by conftest.", family=True),
+    _s("KNN_TPU_IVF_NCENTROIDS", "int", "knn_tpu/ivf/index.py", _PERF,
+       "Default k-means list count of an IVFIndex (unset = "
+       "round(sqrt(n)))."),
+    _s("KNN_TPU_IVF_NPROBE", "int", "knn_tpu/ivf/index.py", _PERF,
+       "Default probed-list count per query (unset = ncentroids/4); "
+       "nprobe = ncentroids reproduces exact brute force bitwise."),
+    _s("KNN_TPU_IVF_TRAIN_ITERS", "int", "knn_tpu/ivf/index.py", _PERF,
+       "Lloyd iterations of the seeded coarse-quantizer training "
+       "(default 5)."),
+    _s("KNN_TPU_IVF_SEED", "int", "knn_tpu/ivf/index.py", _PERF,
+       "Deterministic k-means init seed (default 0); same seed + data "
+       "=> same placement."),
     # --- admission control (knn_tpu.serving.admission) -----------------
     _s("KNN_TPU_ADMISSION_", "family", "knn_tpu/serving/admission.py",
        _SERVING, "Admission-control knob family (ANY set member is an "

@@ -453,6 +453,12 @@ def build_roofline_parser() -> argparse.ArgumentParser:
     p.add_argument("--qps", type=float, default=None,
                    help="a measured q/s to attribute: adds "
                    "roofline_pct to the output")
+    p.add_argument("--nprobe", type=int, default=None,
+                   help="IVF lists probed per query (with --ncentroids: "
+                   "scales the streamed rows by nprobe/ncentroids and "
+                   "renders the probed-bytes term)")
+    p.add_argument("--ncentroids", type=int, default=None,
+                   help="IVF list count (required with --nprobe)")
     p.add_argument("--best", nargs="?", const=10, type=int, default=None,
                    metavar="N",
                    help="rank the FULL autotuner knob grid by modeled "
@@ -498,7 +504,8 @@ def _run_roofline_best(args) -> int:
                 grid_order=knobs["grid_order"], binning=knobs["binning"],
                 tile_n=knobs["tile_n"], block_q=knobs["block_q"],
                 survivors=knobs["survivors"], margin=args.margin,
-                device_kind=args.device_kind, num_devices=args.devices)
+                device_kind=args.device_kind, num_devices=args.devices,
+                nprobe=args.nprobe, ncentroids=args.ncentroids)
         except ValueError:
             continue  # a combination the model refuses
         if not model.get("ceiling_qps"):
@@ -544,6 +551,12 @@ def run_roofline(args: argparse.Namespace) -> int:
 
     from knn_tpu.obs import roofline
 
+    if (args.nprobe is None) != (args.ncentroids is None):
+        # fail loudly here: inside --best the grid loop swallows
+        # ValueError per-candidate and would print an empty ranking
+        print("--nprobe and --ncentroids must be set together",
+              file=sys.stderr)
+        return 2
     if args.best is not None:
         return _run_roofline_best(args)
     if args.selector == "pallas":
@@ -553,13 +566,15 @@ def run_roofline(args: argparse.Namespace) -> int:
             grid_order=args.grid_order, binning=args.binning,
             tile_n=args.tile_n, block_q=args.block_q,
             survivors=args.survivors, margin=args.margin,
-            device_kind=args.device_kind, num_devices=args.devices)
+            device_kind=args.device_kind, num_devices=args.devices,
+            nprobe=args.nprobe, ncentroids=args.ncentroids)
     else:
         model = roofline.xla_cost_model(
             n=args.n, d=args.dim, k=args.k, nq=args.nq,
             selector=args.selector, dtype=args.dtype, batch=args.batch,
             margin=args.margin, device_kind=args.device_kind,
-            num_devices=args.devices)
+            num_devices=args.devices,
+            nprobe=args.nprobe, ncentroids=args.ncentroids)
     block = roofline.attribute(model, args.qps)
     if args.json:
         print(json.dumps(block, indent=1, sort_keys=True))

@@ -1,0 +1,16 @@
+"""Approximate-first IVF tier with a certified escape hatch: k-means
+list-major placement probed by the existing streaming machinery, a
+per-query residual certificate that DETECTS probe misses, and the
+exact fallback that repairs them (docs/PERF.md "IVF tier & certified
+recall").  ``knn_tpu.ivf.artifact`` is importable jax-free."""
+
+from knn_tpu.ivf.index import (  # noqa: F401
+    IVFIndex,
+    IVFServingEngine,
+    SELECTORS,
+)
+from knn_tpu.ivf.kmeans import (  # noqa: F401
+    KMeansResult,
+    quantize_centroids,
+    train_kmeans,
+)

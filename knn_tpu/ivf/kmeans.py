@@ -1,0 +1,128 @@
+"""Seeded, deterministic k-means for the IVF coarse quantizer.
+
+Lloyd iterations split exactly the way the rest of the repo splits
+work: the **assign** step is the existing sharded matmul machinery — a
+:class:`~knn_tpu.parallel.sharded.ShardedKNN` placement of the current
+centroids searched with ``k=1`` (the `_knn_program` SPMD distance +
+lexicographic select, so assignment ties break by centroid index the
+same way every other select in the repo breaks ties) — and the
+**update** step is a host float64 segment mean (``np.add.at``), which
+is deterministic regardless of device count or reduction order.  Empty
+clusters keep their previous centroid (no resampling — reproducibility
+beats marginally better inertia here).
+
+Centroids are float32 and int8-quantizable via the existing
+``ops.quantize`` row scheme (:func:`quantize_centroids`), so an int8
+coarse probe prices centroid bytes the same way the db prices its rows.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class KMeansResult(NamedTuple):
+    #: [C, D] float32 centroids (row c = mean of its members, f64 math)
+    centroids: np.ndarray
+    #: [N] int64 list assignment of every training row
+    assign: np.ndarray
+    #: [C] int64 member count per list
+    counts: np.ndarray
+    #: [C] float64 max residual ``max ||x - c||`` per list (0 for empty
+    #: lists) — the radius the probe certificate subtracts
+    residuals: np.ndarray
+    #: float64 sum of squared residuals (Lloyd objective, for tests)
+    inertia: float
+    #: Lloyd iterations actually run
+    iters: int
+
+
+def assign_lists(rows: np.ndarray, centroids: np.ndarray, *, mesh,
+                 train_tile: Optional[int] = None) -> np.ndarray:
+    """[N] nearest-centroid assignment via the sharded k=1 search — the
+    SPMD assign step.  Tie order is the lexicographic (distance, index)
+    select every device program in the repo uses."""
+    from knn_tpu.parallel.sharded import ShardedKNN
+
+    knn = ShardedKNN(np.asarray(centroids, np.float32), mesh=mesh, k=1,
+                     metric="l2", train_tile=train_tile)
+    _, idx = knn.search(np.asarray(rows, np.float32))
+    return np.asarray(idx).reshape(-1).astype(np.int64)
+
+
+def _residuals(rows64: np.ndarray, centroids: np.ndarray,
+               assign: np.ndarray, ncentroids: int):
+    """Per-list max residual radius + inertia, float64 throughout.  The
+    radius must upper-bound EVERY member's distance to its list
+    centroid — conservative is safe (extra fallback), an undercount is
+    not — so it is computed host-side in f64, never from device f32."""
+    diff = rows64 - centroids.astype(np.float64)[assign]
+    sq = np.einsum("nd,nd->n", diff, diff)
+    res = np.zeros(ncentroids, np.float64)
+    np.maximum.at(res, assign, np.sqrt(sq))
+    return res, float(sq.sum())
+
+
+def _farthest_point_init(rows64: np.ndarray, ncentroids: int,
+                         seed: int) -> np.ndarray:
+    """Deterministic farthest-point init: the seed picks the first
+    centroid row, each next centroid is the row farthest from the
+    chosen set (ties → lowest index).  One O(C·N·D) pass — the cost of
+    a single assign step — and on separated data it lands one seed per
+    blob, which plain random sampling misses with near certainty (a
+    split blob forces the certificate to flag every query in it)."""
+    n = rows64.shape[0]
+    rng = np.random.default_rng(seed)
+    picks = [int(rng.integers(n))]
+    min_sq = np.einsum("nd,nd->n",
+                       rows64 - rows64[picks[0]],
+                       rows64 - rows64[picks[0]])
+    for _ in range(1, ncentroids):
+        picks.append(int(np.argmax(min_sq)))
+        diff = rows64 - rows64[picks[-1]]
+        np.minimum(min_sq, np.einsum("nd,nd->n", diff, diff),
+                   out=min_sq)
+    return np.sort(np.asarray(picks))
+
+
+def train_kmeans(rows: np.ndarray, ncentroids: int, *, mesh,
+                 iters: int = 5, seed: int = 0,
+                 train_tile: Optional[int] = None) -> KMeansResult:
+    """Seeded Lloyd: deterministic farthest-point init
+    (:func:`_farthest_point_init`), SPMD assign, host f64 segment-mean
+    update."""
+    rows = np.ascontiguousarray(np.asarray(rows, np.float32))
+    n, d = rows.shape
+    ncentroids = int(min(max(1, ncentroids), n))
+    rows64 = rows.astype(np.float64)
+    init = _farthest_point_init(rows64, ncentroids, seed)
+    centroids = rows[init].copy()
+    assign = np.zeros(n, np.int64)
+    it = 0
+    for it in range(1, max(1, int(iters)) + 1):
+        assign = assign_lists(rows, centroids, mesh=mesh,
+                              train_tile=train_tile)
+        sums = np.zeros((ncentroids, d), np.float64)
+        np.add.at(sums, assign, rows64)
+        counts = np.bincount(assign, minlength=ncentroids)
+        new = centroids.astype(np.float64)
+        nz = counts > 0
+        new[nz] = sums[nz] / counts[nz, None]
+        centroids = new.astype(np.float32)
+    assign = assign_lists(rows, centroids, mesh=mesh,
+                          train_tile=train_tile)
+    counts = np.bincount(assign, minlength=ncentroids).astype(np.int64)
+    residuals, inertia = _residuals(rows64, centroids, assign, ncentroids)
+    return KMeansResult(centroids, assign, counts, residuals, inertia, it)
+
+
+def quantize_centroids(centroids: np.ndarray):
+    """Int8 row quantization of the centroid table via the db scheme
+    (``ops.quantize.quantize_rows_np``) — same per-row scale + bound
+    discipline as the corpus, so an int8 coarse probe has certified
+    error bounds exactly like an int8 db pass."""
+    from knn_tpu.ops.quantize import quantize_rows_np
+
+    return quantize_rows_np(np.asarray(centroids, np.float32))

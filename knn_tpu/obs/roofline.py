@@ -96,7 +96,18 @@ from knn_tpu.obs import names, registry, trace
 #: ``bound_class`` may read ``dcn_bound``.  Single-host blocks are
 #: numerically unchanged; the bump re-keys the tuning cache and
 #: calibration store so pre-DCN attributions self-invalidate.
-MODEL_VERSION = 4
+#: 5 = the IVF probed-bytes term: ``nprobe``/``ncentroids`` on a block
+#: scale every row-proportional term by ``probe_fraction = nprobe /
+#: ncentroids`` — a probed search streams and scores only the gathered
+#: lists (``expected_probe_fraction × db stream``), which is the whole
+#: point of the tier — plus a centroid-scan add-on (the [C, d] table
+#: bytes + ``2·nq·C·d`` assign flops) pricing the probe itself, under
+#: ``terms.probe``.  Blocks without the knobs are numerically
+#: unchanged; probed blocks skip the calibration overlay (no measured
+#: entry covers a pruned stream yet — an explicit absent verdict beats
+#: mis-scaling) and the bump re-keys the tuning cache and calibration
+#: store so pre-IVF attributions self-invalidate.
+MODEL_VERSION = 5
 
 #: the resources a config can exhaust, in tie-break order (dcn_bound
 #: only appears on multi-host blocks, db_hosts > 1)
@@ -349,6 +360,14 @@ def _consult_calibration(model: dict, nq: int,
             "applied": False,
             "note": "multi-host blocks use the analytic DCN model"}
         return
+    if "probe" in model.get("terms", {}):
+        # probed (IVF) blocks: every measured entry covers a full-db
+        # stream; applying its factors to a pruned stream would claim a
+        # measured ceiling for an unmeasured shape
+        model["calibration"] = {
+            "applied": False,
+            "note": "probed blocks use the analytic IVF model"}
+        return
     try:
         entry = calibrate.lookup_for_block(model)
     except Exception as e:  # noqa: BLE001 — overlay must not kill the model
@@ -400,6 +419,32 @@ def _consult_calibration(model: dict, nq: int,
     }
 
 
+def _probe_setup(n: int, d: int, nq: int, nprobe: Optional[int],
+                 ncentroids: Optional[int]):
+    """The MODEL_VERSION-5 IVF pruning substitution: ``(n_eff, probe)``
+    where ``n_eff`` is the expected row count a probed search actually
+    streams (``ceil(n * nprobe / ncentroids)`` — balanced lists, the
+    training objective) and ``probe`` prices the centroid scan the
+    pruning costs: the [C, d] f32 table plus the per-query [C] f32
+    distances, and ``2·nq·C·d`` assign flops.  Both knobs None → the
+    identity ``(n, None)``; exactly one set is a config error."""
+    if nprobe is None and ncentroids is None:
+        return int(n), None
+    if nprobe is None or ncentroids is None:
+        raise ValueError("nprobe and ncentroids must be set together")
+    cc = max(1, int(ncentroids))
+    pp = min(max(1, int(nprobe)), cc)
+    n_eff = _ceil_div(int(n) * pp, cc)
+    return n_eff, {
+        "nprobe": pp,
+        "ncentroids": cc,
+        "probe_fraction": pp / cc,
+        "rows_probed": int(n_eff),
+        "centroid_table_bytes": int(cc * d * 4 + nq * cc * 4),
+        "assign_flops": 2.0 * nq * cc * d,
+    }
+
+
 def _dcn_term(nq: int, k: int, db_hosts: int, dcn_merge: Optional[str],
               device_kind, peaks) -> Optional[dict]:
     """The MODEL_VERSION-4 cross-host merge term, or None on a
@@ -432,6 +477,7 @@ def pallas_cost_model(
     device_kind: Optional[str] = None, backend: Optional[str] = None,
     num_devices: int = 1, peaks: Optional[Dict[str, float]] = None,
     db_hosts: int = 1, dcn_merge: Optional[str] = None,
+    nprobe: Optional[int] = None, ncentroids: Optional[int] = None,
 ) -> dict:
     """The roofline model of one Pallas-selector config (see module
     docstring for the terms).  ``None`` knobs take the library defaults
@@ -442,7 +488,9 @@ def pallas_cost_model(
     (MODEL_VERSION 4): the hierarchical top-k merge ships each host's
     ``[nq, k]`` candidate list over DCN at the ``dcn_merge`` strategy
     (None = the measured crossover pick), serialized after the
-    per-host compute."""
+    per-host compute.  ``nprobe``/``ncentroids`` (MODEL_VERSION 5)
+    scale the streamed rows by the expected probe fraction and add the
+    centroid-scan term (``_probe_setup``)."""
     precision = precision or "bf16x3"
     kernel = kernel or "tiled"
     if kernel not in ("tiled", "streaming", "fused"):
@@ -456,6 +504,8 @@ def pallas_cost_model(
     if peaks is None:
         peaks, estimated = peaks_for(device_kind, backend)
 
+    n_total = int(n)
+    n, probe = _probe_setup(n_total, d, nq, nprobe, ncentroids)
     n_dev = _ceil_div(n, max(1, int(num_devices)))
     tile = min(tile, max(BIN_W, _ceil_div(n_dev, BIN_W) * BIN_W))
     n_tiles = _ceil_div(n_dev, tile)
@@ -498,12 +548,17 @@ def pallas_cost_model(
     # once per query block — identical bytes, fewer launches)
     cand_b = q_blocks * n_tiles * bq * (out_w * 8 + bound_w * 4)
     hbm_total = db_stream + db_aux + queries_b + cand_b
+    if probe is not None:
+        hbm_total += probe["centroid_table_bytes"]
     t_hbm = hbm_total / (peaks["hbm_gbps"] * 1e9)
 
     # --- MXU flops ------------------------------------------------------
     useful = 2.0 * nq * n * d
     passes = MXU_PASSES[precision]
     executed = useful * passes
+    if probe is not None:
+        useful += probe["assign_flops"]
+        executed += probe["assign_flops"]
     mxu_rate = peaks["int8_flops"] if precision == "int8" else \
         peaks["bf16_flops"]
     # executed flops are per-device work summed over the (perfectly
@@ -522,7 +577,7 @@ def pallas_cost_model(
         "peaks": {"hbm_gbps": peaks["hbm_gbps"],
                   "mxu_flops": mxu_rate, "vpu_ops": peaks["vpu_ops"]},
         "config": {
-            "n": int(n), "d": int(d), "k": int(k), "nq": int(nq),
+            "n": n_total, "d": int(d), "k": int(k), "nq": int(nq),
             "precision": precision, "kernel": kernel,
             "grid_order": grid_order, "binning": binning,
             "tile_n": tile, "block_q": bq, "survivors": surv,
@@ -550,6 +605,11 @@ def pallas_cost_model(
             },
         },
     }
+    if probe is not None:
+        model["config"]["nprobe"] = probe["nprobe"]
+        model["config"]["ncentroids"] = probe["ncentroids"]
+        model["config"]["probe_fraction"] = probe["probe_fraction"]
+        model["terms"]["probe"] = probe
     dcn = _dcn_term(nq, k, db_hosts, dcn_merge, device_kind, peaks)
     if dcn is not None:
         model["terms"]["dcn"] = dcn
@@ -577,11 +637,14 @@ def xla_cost_model(
     backend: Optional[str] = None, num_devices: int = 1,
     peaks: Optional[Dict[str, float]] = None,
     db_hosts: int = 1, dcn_merge: Optional[str] = None,
+    nprobe: Optional[int] = None, ncentroids: Optional[int] = None,
 ) -> dict:
     """Roofline for the XLA selectors: ``exact`` (coarse ``lax.top_k``,
     one db pass) and ``approx`` (ApproxTopK coarse + the count-below
     certificate matmul, two passes).  The db streams once per
-    ``batch``-query chunk per pass at the placement dtype's width."""
+    ``batch``-query chunk per pass at the placement dtype's width.
+    ``nprobe``/``ncentroids`` apply the MODEL_VERSION-5 IVF pruning
+    substitution exactly as in ``pallas_cost_model``."""
     if selector not in ("exact", "approx"):
         raise ValueError(f"xla selector {selector!r} not in "
                          f"('exact', 'approx')")
@@ -593,6 +656,8 @@ def xla_cost_model(
     if peaks is None:
         peaks, estimated = peaks_for(device_kind, backend)
 
+    n_total = int(n)
+    n, probe = _probe_setup(n_total, d, nq, nprobe, ncentroids)
     n_dev = _ceil_div(n, max(1, int(num_devices)))
     chunks = _ceil_div(nq, bs)
     passes = 1 if selector == "exact" else 2
@@ -602,10 +667,15 @@ def xla_cost_model(
     queries_b = passes * nq * d * 4
     cand_b = passes * nq * min(n, k + margin) * 8
     hbm_total = db_stream + db_aux + queries_b + cand_b
+    if probe is not None:
+        hbm_total += probe["centroid_table_bytes"]
     t_hbm = hbm_total / (peaks["hbm_gbps"] * 1e9)
 
     useful = 2.0 * nq * n * d
     executed = useful * passes * _DTYPE_PASSES[dtype]
+    if probe is not None:
+        useful += probe["assign_flops"]
+        executed += probe["assign_flops"]
     t_mxu = executed / max(1, int(num_devices)) / peaks["bf16_flops"]
 
     sel_ops = XLA_SELECT_OPS[selector]
@@ -621,7 +691,7 @@ def xla_cost_model(
                   "mxu_flops": peaks["bf16_flops"],
                   "vpu_ops": peaks["vpu_ops"]},
         "config": {
-            "n": int(n), "d": int(d), "k": int(k), "nq": int(nq),
+            "n": n_total, "d": int(d), "k": int(k), "nq": int(nq),
             "dtype": dtype, "batch": bs, "passes": passes,
             "margin": int(margin), "num_devices": int(num_devices),
             "db_hosts": max(1, int(db_hosts)),
@@ -648,6 +718,11 @@ def xla_cost_model(
             },
         },
     }
+    if probe is not None:
+        model["config"]["nprobe"] = probe["nprobe"]
+        model["config"]["ncentroids"] = probe["ncentroids"]
+        model["config"]["probe_fraction"] = probe["probe_fraction"]
+        model["terms"]["probe"] = probe
     dcn = _dcn_term(nq, k, db_hosts, dcn_merge, device_kind, peaks)
     if dcn is not None:
         model["terms"]["dcn"] = dcn
@@ -786,6 +861,10 @@ def block_for_bench_line(rec: dict) -> Optional[dict]:
     backend = rec.get("backend")
     devices = int(rec.get("devices") or 1)
     nq = int(rec.get("batch") or 4096)
+    ivf = rec.get("ivf") if isinstance(rec.get("ivf"), dict) else {}
+    probe_kw = ({"nprobe": int(ivf["nprobe"]),
+                 "ncentroids": int(ivf["ncentroids"])}
+                if ivf.get("nprobe") and ivf.get("ncentroids") else {})
     try:
         if mode == "certified_pallas":
             knobs = rec.get("pallas_knobs") or {}
@@ -799,7 +878,7 @@ def block_for_bench_line(rec: dict) -> Optional[dict]:
                 survivors=knobs.get("survivors"),
                 margin=int(knobs.get("margin") or 28),
                 device_kind=device_kind, backend=backend,
-                num_devices=devices)
+                num_devices=devices, **probe_kw)
             measured = rec.get("device_phase_qps") or rec.get("value")
         elif mode in ("exact", "certified_approx"):
             model = xla_cost_model(
@@ -807,7 +886,7 @@ def block_for_bench_line(rec: dict) -> Optional[dict]:
                 selector="exact" if mode == "exact" else "approx",
                 dtype=rec.get("compute_dtype"), batch=rec.get("batch"),
                 device_kind=device_kind, backend=backend,
-                num_devices=devices)
+                num_devices=devices, **probe_kw)
             measured = rec.get("value")
         else:
             return None
@@ -868,6 +947,14 @@ def render_text(block: dict) -> str:
             f"-> {dc.get('time_s', 0) * 1e3:9.3f} ms   "
             f"({dc.get('hosts')} hosts, {dc.get('strategy')} merge at "
             f"{dc.get('rate_gbps')} GB/s)")
+    pr = terms.get("probe")
+    if pr:
+        lines.append(
+            f"  probed:     {pr.get('rows_probed', 0) / 1e6:10.3f} Mrow "
+            f"of {(cfg.get('n') or 0) / 1e6:.3f} M    "
+            f"(nprobe {pr.get('nprobe')}/{pr.get('ncentroids')} lists = "
+            f"{pr.get('probe_fraction', 0):.4f} of db bytes, centroid "
+            f"scan {pr.get('centroid_table_bytes', 0) / 1e6:.3f} MB)")
     overlap = (" select overlapped" if block.get("select_overlapped")
                else "")
     cal = block.get("calibration")

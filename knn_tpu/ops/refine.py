@@ -148,3 +148,21 @@ def refine_exact(
         np.take_along_axis(d_sorted, srt2, axis=-1),
         np.take_along_axis(i_sorted, srt2, axis=-1),
     )
+
+
+def refine_shared_exact(
+    db: np.ndarray,
+    queries: np.ndarray,
+    positions: np.ndarray,
+    k: int,
+    metric: str = "l2",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """:func:`refine_exact` where every query shares ONE candidate set
+    (a 1-D position array) — the IVF certified-fallback shape, where a
+    flagged query re-scores every live row.  Bitwise-identical to
+    ``refine_exact(db, queries, np.broadcast_to(positions, (Q, M)), k)``
+    (it IS that call; the broadcast view materializes only per chunk
+    inside refine_exact's gather, never as a [Q, M] index array)."""
+    positions = np.asarray(positions, dtype=np.int64).reshape(-1)
+    cand = np.broadcast_to(positions, (queries.shape[0], positions.shape[0]))
+    return refine_exact(db, queries, cand, k, metric)

@@ -317,6 +317,34 @@ def _hosttier_program(
     )
 
 
+def segment_search_program(
+    mesh: Mesh,
+    k: int,
+    metric: str = "l2",
+    merge: Optional[str] = None,
+    *,
+    train_tile: Optional[int] = None,
+    compute_dtype=None,
+    dcn_merge: Optional[str] = None,
+):
+    """Public handle on the host-tier segment program for callers that
+    stream GATHERED row blocks instead of contiguous db segments — the
+    IVF probed-list path (knn_tpu.ivf.index): the gather of probed list
+    extents pads to a fixed rung and masks via the same traced
+    ``n_valid`` operand, so probing shrinks streamed bytes without new
+    kernels or a recompile per probe set.  ``merge`` resolves through
+    the same crossover table a :class:`ShardedKNN` placement uses;
+    the returned callable is ``prog(qp, tp, n_valid)`` with the
+    :func:`_hosttier_program` contract (shared lru compile cache)."""
+    _, chips = db_topology(mesh)
+    merge, _src = crossover.resolve_merge(merge, k, chips)
+    dtype_key = (
+        None if compute_dtype is None else jnp.dtype(compute_dtype).name
+    )
+    return _hosttier_program(mesh, k, metric, merge, train_tile,
+                             dtype_key, dcn_merge=dcn_merge)
+
+
 #: bounded-retry policy for transient device failures inside long sweeps
 #: (SURVEY §5 failure row; the same per-batch unit streaming.py uses).
 #: ValueError/TypeError are caller bugs and never retried.  Waits double

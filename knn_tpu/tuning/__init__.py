@@ -20,7 +20,9 @@ from knn_tpu.tuning.autotune import (
     DEFAULT_KNOBS,
     PRUNE_ENV,
     autotune,
+    autotune_ivf,
     counters,
+    ivf_grid,
     knob_grid,
     prune_candidates,
     prune_threshold_from_env,
@@ -39,7 +41,9 @@ __all__ = [
     "DEFAULT_KNOBS",
     "PRUNE_ENV",
     "autotune",
+    "autotune_ivf",
     "counters",
+    "ivf_grid",
     "knob_grid",
     "prune_candidates",
     "prune_threshold_from_env",

@@ -123,6 +123,7 @@ def run_lint(repo) -> int:
                   f"{_c('calibration') + _c('campaign')} blocks")
         for name, label in (("loadgen_knee", "knee"),
                             ("mutation", "mutation"),
+                            ("ivf", "ivf"),
                             ("multihost", "multihost"),
                             ("sentinel", "sentinel verdict")):
             viol = sum(1 for p in problems if p["schema"] == name)

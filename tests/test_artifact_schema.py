@@ -181,7 +181,8 @@ def test_version_tokens_resolve_and_are_owned_once():
     assert owners == {"model_version": "roofline",
                       "campaign_version": "campaign",
                       "version": "loadgen_knee",
-                      "mutation_version": "mutation"}
+                      "mutation_version": "mutation",
+                      "ivf_version": "ivf"}
 
 
 def test_catalog_refuses_duplicate_version_tokens():
@@ -216,6 +217,8 @@ def test_sentinel_curated_fields_derived_in_legacy_order():
         ("knee_qps", "higher"),
         ("model_residual_pct", "lower"),
         ("mutation_admitted_p99_ms", "lower"),
+        ("recall_at_k", "higher"),
+        ("ivf_qps", "higher"),
     )
 
 
