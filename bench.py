@@ -1201,12 +1201,22 @@ def main() -> None:
                       num_devices=len(mesh.devices.ravel()))
         pb = entry.get("phase_breakdown") or {}
         if mode == "certified_pallas":
+            pq_kw = {}
+            if KNOBS["precision"] == "pq":
+                # price the pq arm at the geometry the placement
+                # actually trained (env-tunable), not the module default
+                try:
+                    plq = prog._pq_placement()
+                    pq_kw = dict(pq_dsub=int(plq["dsub"]),
+                                 pq_ncodes=int(plq["ncodes"]))
+                except Exception:  # noqa: BLE001 — advisory pricing only
+                    pass
             model = _rl.pallas_cost_model(
                 nq=NQ, precision=KNOBS["precision"],
                 kernel=KNOBS["kernel"], grid_order=KNOBS["grid_order"],
                 binning=KNOBS["binning"], tile_n=KNOBS["tile_n"],
                 block_q=KNOBS["block_q"], survivors=KNOBS["survivors"],
-                margin=MARGIN, **common)
+                margin=MARGIN, **pq_kw, **common)
             measured = pb.get("device_qps") or entry.get("qps_mean")
         elif mode == "serving":
             # the bucketed engine dispatches the exact-search program;
@@ -1675,27 +1685,64 @@ def main() -> None:
             rl_top = {"error": f"{type(e).__name__}: {e}"}
     rl_fields = {"roofline": rl_top}
     # quantization provenance: precision rides top-level on EVERY line so
-    # int8 A/B lines are self-describing and the artifact refresher can
-    # curate them separately from the f32-family line of the same config;
-    # int8 lines add the certified bound's worst case over this query set
-    # and the scales dtype (the reproducibility trio the ISSUE names)
+    # the precision-ladder A/B lines (int8 / int4 / pq vs the f32 family)
+    # are self-describing and the artifact refresher can curate them
+    # separately per arm; quantized lines add the certified bound's worst
+    # case over this query set and the scales dtype (the reproducibility
+    # trio the ISSUE names), and pq lines carry their codebook geometry
     quant_prov = {"precision": KNOBS["precision"]}
-    if KNOBS["precision"] == "int8":
+    if KNOBS["precision"] in ("int8", "int4"):
         try:
             from knn_tpu.ops import quantize as _qz
 
-            pl8 = prog._int8_placement()
+            plq = (prog._int8_placement() if KNOBS["precision"] == "int8"
+                   else prog._int4_placement())
             qb_prov = queries
             if METRIC == "cosine":
                 from knn_tpu.parallel.sharded import _row_normalize_f64
 
                 qb_prov = _row_normalize_f64(queries)
             eps = _qz.score_error_bound(
-                qb_prov, pl8["stats"], offset=pl8["offset"])
+                qb_prov, plq["stats"], offset=plq["offset"])
             quant_prov["quant_bound_max"] = float(np.max(eps))
             quant_prov["quant_scales_dtype"] = "float32"
         except Exception as e:  # noqa: BLE001 — provenance must not kill the line
             quant_prov["quant_bound_error"] = f"{type(e).__name__}: {e}"
+    elif KNOBS["precision"] == "pq":
+        # pq lines additionally carry the cataloged "pq" artifact block
+        # (knn_tpu.analysis.artifacts): codebook geometry + the
+        # certified bound's worst case, validated/swept like every
+        # other bench block
+        try:
+            from knn_tpu.analysis import widths as _widths
+            from knn_tpu.ops import pq as _pqm
+            from knn_tpu.ops.pq_artifact import PQ_VERSION
+
+            plq = prog._pq_placement()
+            qb_prov = queries
+            if METRIC == "cosine":
+                from knn_tpu.parallel.sharded import _row_normalize_f64
+
+                qb_prov = _row_normalize_f64(queries)
+            eps = _pqm.score_error_bound_pq(qb_prov, plq["stats"])
+            quant_prov["quant_bound_max"] = float(np.max(eps))
+            quant_prov["quant_scales_dtype"] = "float32"
+            nsub = _widths.pq_nsub(DIM, int(plq["dsub"]))
+            quant_prov["pq"] = {
+                "pq_version": PQ_VERSION,
+                "dsub": int(plq["dsub"]),
+                "ncodes": int(plq["ncodes"]),
+                "nsub": nsub,
+                "lut_bytes": _widths.pq_lut_bytes(
+                    int(qb_prov.shape[0]), DIM, dsub=int(plq["dsub"]),
+                    ncodes=int(plq["ncodes"])),
+                "bound_max": float(np.max(eps)),
+                "queries": int(qb_prov.shape[0]),
+            }
+        except Exception as e:  # noqa: BLE001 — provenance must not kill the line
+            quant_prov["quant_bound_error"] = f"{type(e).__name__}: {e}"
+            quant_prov.setdefault("pq", {})["error"] = (
+                f"{type(e).__name__}: {e}")
     line = {
         "metric": f"knn_qps_{CONFIG}_n{N}_d{DIM}_k{K}",
         "value": qps,

@@ -869,6 +869,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("loadgen_knee", "any"),
             Field("mutation", "any"),
             Field("ivf", "any"),
+            Field("pq", "any"),
             Field("multihost", "any"),
             Field("campaign", "any"),
             Field("sentinel", "any"),
@@ -881,6 +882,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("knee_qps", "number", nullable=True),
             Field("mutation_admitted_p99_ms", "number", nullable=True),
             Field("ivf_qps", "number", nullable=True),
+            Field("bytes_streamed_ratio", "number", nullable=True),
             Field("multihost_hosts", "int", nullable=True),
             Field("multihost_merge", "str", nullable=True),
             Field("multihost_qps", "number", nullable=True),
@@ -1253,10 +1255,18 @@ CATALOG: Tuple[BlockSchema, ...] = (
                        "k", "probe_fraction", "recall_at_k",
                        "fallback_rate", "bytes_streamed_ratio", "qps"),
         missing_legacy="missing {key!r}",
-        hoists=(Hoist("qps", "ivf_qps"),),
+        hoists=(
+            Hoist("qps", "ivf_qps"),
+            Hoist("bytes_streamed_ratio", "bytes_streamed_ratio"),
+        ),
         curated=(
             Curated("recall_at_k", "higher", 9),
             Curated("ivf_qps", "higher", 10),
+            # the compressed-tier headline: fraction of the brute-force
+            # byte stream actually touched — the number the int4/PQ
+            # arms exist to shrink, so the sentinel baselines it
+            # lower-is-better
+            Curated("bytes_streamed_ratio", "lower", 11),
         ),
         checks=(
             Field("ivf_version", "version", required=True,
@@ -1299,6 +1309,52 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("epoch", "any"),
             Field("compactions", "any"),
             Field("validation_errors", "any"),
+            Field("error", "any"),
+        ),
+    ),
+    # --- pq (codebook-geometry provenance of precision="pq" lines) -------
+    BlockSchema(
+        name="pq",
+        block_path="pq",
+        doc="docs/PERF.md#Compressed tiers: int4 & PQ",
+        validator="knn_tpu.ops.pq_artifact:validate_pq_block",
+        emitters=("bench.py",),
+        fingerprints=(frozenset({"pq_version", "dsub"}),),
+        version_field="pq_version",
+        version_ref=Ref("knn_tpu.ops.pq_artifact", "PQ_VERSION"),
+        version_exact=True,
+        not_dict_legacy="pq block must be a dict, got {vtype}",
+        error_exempt="validator",
+        refusal_label="pq",
+        curate=True,
+        sweep=True,
+        missing_order=("pq_version", "dsub", "ncodes", "nsub",
+                       "lut_bytes", "bound_max", "queries"),
+        missing_legacy="missing {key!r}",
+        checks=(
+            Field("pq_version", "version", required=True,
+                  legacy="pq_version must be {version}, got {value!r}"),
+            Field("dsub", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("ncodes", "int", required=True, ge=2,
+                  legacy="{path} must be an int >= 2, got {value!r}"),
+            Field("nsub", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("lut_bytes", "int", required=True, ge=0,
+                  legacy="{path} must be a non-negative int, got "
+                         "{value!r}"),
+            # the certified bound's worst case over the bench query
+            # set; null when the bound computation itself degraded
+            # (the block then carries the error string)
+            Field("bound_max", "number", required=True, nullable=True,
+                  ge=0,
+                  legacy="bound_max must be a non-negative number or "
+                         "null, got {value!r}"),
+            Field("queries", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
             Field("error", "any"),
         ),
     ),

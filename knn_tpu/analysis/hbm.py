@@ -26,10 +26,14 @@ from __future__ import annotations
 
 from typing import List, Tuple
 
+from knn_tpu.analysis import widths as _widths
+
 #: f32 aux bytes the placement keeps beside each row (the squared row
 #: norm the distance programs hoist); the int8 tier would add scales,
-#: but the host-RAM tier streams the f32 placement
-AUX_BYTES_PER_ROW = 4
+#: but the host-RAM tier streams the f32 placement.  A view of the ONE
+#: shared width table (analysis.widths) — the same constant the
+#: roofline's db_aux term and this module's placement arithmetic price.
+AUX_BYTES_PER_ROW = _widths.AUX_BYTES_PER_ROW
 
 
 def placement_bytes(n_rows: int, dim: int, itemsize: int = 4) -> int:

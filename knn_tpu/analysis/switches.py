@@ -141,6 +141,14 @@ SWITCHES: Tuple[Switch, ...] = (
     _s("KNN_TPU_HOSTTIER_DEPTH", "int", "knn_tpu/parallel/sharded.py",
        _PERF, "Bounded in-flight sweep depth of the host-RAM tier's "
        "dispatch-ahead stream (default 2)."),
+    # --- PQ compressed tier (knn_tpu.parallel.sharded) -----------------
+    _s("KNN_TPU_PQ_DSUB", "int", "knn_tpu/parallel/sharded.py", _PERF,
+       "Dims per PQ subspace for the precision=\"pq\" placement "
+       "(default 4); row code bytes = ceil(dim / dsub)."),
+    _s("KNN_TPU_PQ_NCODES", "int", "knn_tpu/parallel/sharded.py",
+       _PERF, "Codebook size per PQ subspace (default 256, one uint8 "
+       "code); larger books shrink the certified bound but widen the "
+       "per-query LUT."),
     # --- mutable index (knn_tpu.index.mutable) -------------------------
     _s("KNN_TPU_DELTA_MIN_ROWS", "int", "knn_tpu/index/mutable.py",
        _INDEX, "Smallest delta-tail capacity ladder rung (rows, "

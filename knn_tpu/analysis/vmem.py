@@ -19,10 +19,12 @@ arithmetic into a jax-free home so
   at least one known device kind at the headline shape.
 
 Geometry constants mirror ``ops.pallas_knn`` (TILE_N/BLOCK_Q/BIN_W/
-DIM_CHUNK/MAX_CARRY_DEPTH) and operand widths mirror
-``obs.roofline.DB_ELEM_BYTES`` — tests/test_analysis.py pins both
-mirrors against the source modules, the same lockstep discipline the
-roofline module uses.
+DIM_CHUNK/MAX_CARRY_DEPTH), pinned by tests/test_analysis.py.  The
+per-precision operand widths live since PR 17 in the ONE shared table
+:mod:`knn_tpu.analysis.widths` (this module's ``DB_PARTS``/``AUX_ROWS``
+are ``is``-identity views of it, shared with ``obs.roofline`` and
+``analysis.hbm``) — the lockstep is now structural, not test-enforced
+mirroring.
 
 Capacity provenance: TPU v2/v3 cores carry ~16 MiB of VMEM; v4 and
 every later announced generation carry 128 MiB (the number
@@ -35,28 +37,27 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+from knn_tpu.analysis import widths as _widths
+
 #: mirrors of ops.pallas_knn geometry constants (pinned by test)
 TILE_N_DEFAULT = 16384
 BLOCK_Q_DEFAULT = 128
 BIN_W = 128
-DIM_CHUNK = 128
+DIM_CHUNK = _widths.DIM_CHUNK
 MAX_CARRY_DEPTH = 8
 SURVIVORS_GROUPED_DEFAULT = 2
 
 #: db operand parts per precision: (n_parts, chunk_w, bytes/elem) —
 #: what one db block of ONE part occupies ((tile_n, chunk_w) at the
-#: part dtype); mirrors ops.pallas_knn._bin_candidates
-DB_PARTS: Dict[str, Tuple[int, int, int]] = {
-    "bf16x3": (2, DIM_CHUNK, 2),
-    "bf16x3f": (1, 3 * DIM_CHUNK, 2),
-    "int8": (1, DIM_CHUNK, 1),
-    "highest": (1, DIM_CHUNK, 4),
-    "default": (1, DIM_CHUNK, 4),
-}
+#: part dtype); a VIEW of the shared width table
+#: (knn_tpu.analysis.widths.DB_PARTS).  "pq" is absent: its chunk
+#: width is the shape-dependent code width ``ceil(d / dsub)``
+#: (launch_estimate special-cases it).
+DB_PARTS = _widths.DB_PARTS
 
 #: f32 sublane rows of the aux (norms / norms+scales) block
-AUX_ROWS: Dict[str, int] = {"int8": 16}
-AUX_ROWS_DEFAULT = 8
+AUX_ROWS = _widths.AUX_ROWS
+AUX_ROWS_DEFAULT = _widths.AUX_ROWS_DEFAULT
 
 #: per-device-kind VMEM capacity in bytes (see module docstring)
 MIB = 1024 * 1024
@@ -114,9 +115,9 @@ def _ceil_div(a: int, b: int) -> int:
 def _geometry(n: int, d: int, precision: str, kernel: str,
               tile_n: Optional[int], block_q: Optional[int],
               survivors: Optional[int], binning: str):
-    if precision not in DB_PARTS:
+    if precision != "pq" and precision not in DB_PARTS:
         raise ValueError(
-            f"precision {precision!r} not in {sorted(DB_PARTS)}")
+            f"precision {precision!r} not in {sorted(DB_PARTS) + ['pq']}")
     tile = int(tile_n or TILE_N_DEFAULT)
     # the kernel pads the db to a tile multiple; an oversize tile caps
     # at the padded row count (mirrors obs.roofline's clamp)
@@ -142,6 +143,7 @@ def launch_estimate(
     precision: Optional[str] = None, kernel: Optional[str] = None,
     tile_n: Optional[int] = None, block_q: Optional[int] = None,
     survivors: Optional[int] = None, binning: Optional[str] = None,
+    pq_dsub: Optional[int] = None, pq_ncodes: Optional[int] = None,
 ) -> dict:
     """Estimated VMEM high-water bytes of ONE kernel launch for this
     knob set, with the per-buffer breakdown.
@@ -169,10 +171,23 @@ def launch_estimate(
             f"kernel {kernel!r} not in ('tiled', 'streaming', 'fused')")
     tile, bq, n_tiles, dim_p, nd, out_w, bound_w = _geometry(
         n, d, precision, kernel, tile_n, block_q, survivors, binning)
-    n_parts, chunk_w, part_b = DB_PARTS[precision]
+    lut_w = 0
+    if precision == "pq":
+        # one db block is the [tile_n, m] byte code tensor; the
+        # query-side block is the whole [block_q, m·ncodes] f32 LUT
+        # (lane-padded), consumed in ONE dot — there is no dim-chunk
+        # loop (ops.pallas_knn._bin_candidates pq arm)
+        m_sub = _widths.pq_nsub(d, pq_dsub)
+        n_parts, chunk_w, part_b = 1, m_sub, 1
+        lut_w = _ceil_div(
+            m_sub * int(pq_ncodes or _widths.PQ_NCODES_DEFAULT),
+            BIN_W) * BIN_W
+        nd = 1
+    else:
+        n_parts, chunk_w, part_b = DB_PARTS[precision]
     aux_rows = AUX_ROWS.get(precision, AUX_ROWS_DEFAULT)
-    q_elem = 1 if precision == "int8" else 4
-    q_extra_b = bq * BIN_W * 4 if precision == "int8" else 0
+    q_elem = 1 if precision in ("int8", "int4") else 4
+    q_extra_b = bq * BIN_W * 4 if precision in ("int8", "int4") else 0
 
     db_block = n_parts * tile * chunk_w * part_b
     aux_block = aux_rows * tile * 4
@@ -180,7 +195,8 @@ def launch_estimate(
     accum = bq * tile * 4 if nd > 1 else 0
 
     if kernel == "tiled":
-        q_block = bq * DIM_CHUNK * q_elem
+        q_block = bq * lut_w * 4 if precision == "pq" \
+            else bq * DIM_CHUNK * q_elem
         out_block = bq * (out_w * 8 + bound_w * 4)
         inputs = db_block + aux_block + q_block + q_extra_b
         total = 2 * inputs + 2 * out_block + score + accum
@@ -193,7 +209,8 @@ def launch_estimate(
             "accum_scratch": accum,
         }
     else:
-        q_block = bq * dim_p * q_elem
+        q_block = bq * lut_w * 4 if precision == "pq" \
+            else bq * dim_p * q_elem
         out_block = bq * (2 * n_tiles * out_w + n_tiles * bound_w) * 4
         buf = 2 * (db_block + aux_block)  # the explicit scratch slots
         carry = 0
@@ -236,7 +253,8 @@ def check_candidate(
         n=n, d=d, k=k, margin=margin,
         precision=knobs.get("precision"), kernel=knobs.get("kernel"),
         tile_n=knobs.get("tile_n"), block_q=knobs.get("block_q"),
-        survivors=knobs.get("survivors"), binning=knobs.get("binning"))
+        survivors=knobs.get("survivors"), binning=knobs.get("binning"),
+        pq_dsub=knobs.get("pq_dsub"), pq_ncodes=knobs.get("pq_ncodes"))
     out = {
         "checked": budget is not None,
         "estimate_bytes": est["total_bytes"],
@@ -264,7 +282,9 @@ def fits_some_kind(knobs: dict, *, n: int, d: int, k: int,
             kernel=knobs.get("kernel"), tile_n=knobs.get("tile_n"),
             block_q=knobs.get("block_q"),
             survivors=knobs.get("survivors"),
-            binning=knobs.get("binning"))["total_bytes"]
+            binning=knobs.get("binning"),
+            pq_dsub=knobs.get("pq_dsub"),
+            pq_ncodes=knobs.get("pq_ncodes"))["total_bytes"]
     except ValueError:
         return True  # unpriceable: never exclude on a model gap
     return est <= max(VMEM_BYTES_BY_KIND.values())

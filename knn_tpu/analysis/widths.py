@@ -1,0 +1,155 @@
+"""ONE shared per-precision operand byte-width table.
+
+Before PR 17 the db-operand stream widths lived three times over —
+``obs.roofline.DB_ELEM_BYTES`` (the cost model), ``analysis.vmem.DB_PARTS``
+(the launch budget), and ``analysis.hbm``'s itemsize arithmetic (the
+placement budget) — pinned against each other by tests but still three
+places to edit.  With the sub-int8 arms (int4 nibble-packed rows, PQ
+byte codes whose row width depends on ``ceil(d / dsub)``) a drifted
+mirror would mis-price exactly the byte term those arms exist to
+shrink, so the widths now live HERE and all three consumers import
+them; tests/test_analysis.py pins the identity (``is``, not ``==``) so
+a re-forked table can't reappear.
+
+Jax-free on purpose: every consumer is a jax-free analysis/obs module.
+
+Layout provenance (what the kernels actually stream,
+``ops.pallas_knn._bin_candidates``):
+
+- ``bf16x3``  : precomputed bf16 hi+lo db parts, 2+2 B/elem.
+- ``bf16x3f`` : one 3x-wide bf16 contraction, 6 B/elem.
+- ``int8``    : per-row symmetric int8 rows, 1 B/elem.
+- ``int4``    : per-row symmetric 4-bit rows packed two-nibbles-per-byte
+  (``ops.quantize.pack_nibbles``), 0.5 B/elem — the db-stream halving
+  the PR 17 roofline target prices.  Dims pad to DIM_CHUNK first, so
+  bytes/row = ``ceil_to(d, 128) / 2`` exactly.
+- ``pq``      : one byte code per subspace, ``ceil(d / dsub)`` B/row
+  (``ops.pq``); per-element width is shape-dependent, so consumers call
+  :func:`db_row_bytes` instead of indexing ``DB_ELEM_BYTES``.
+- ``highest`` / ``default``: the raw f32 rows, 4 B/elem.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+#: dim-chunk width every kernel slices the feature axis by (mirror of
+#: ops.pallas_knn.DIM_CHUNK, pinned by test)
+DIM_CHUNK = 128
+
+#: db stream width per element by kernel matmul precision.  int4 is the
+#: only fractional entry (two dims per byte); "pq" is deliberately
+#: ABSENT — its row width is ``ceil(d / dsub)`` bytes, shape-dependent,
+#: served by :func:`db_row_bytes`.
+DB_ELEM_BYTES: Dict[str, float] = {
+    "bf16x3": 4, "bf16x3f": 6, "int8": 1, "int4": 0.5,
+    "highest": 4, "default": 4,
+}
+
+#: f32 sublane rows of the per-tile aux block: 8 rows of broadcast row
+#: norms, and int8 stacks 8 broadcast scale rows under them (16).
+#: int4 instead PACKS norms (row 0) + scales (row 1) into the default
+#: 8-row block — the kernel reads exactly one row of each, and the
+#: packed layout halves an aux stream that would otherwise weigh as
+#: much as the nibble-packed values at d=128.  PQ needs no db-side
+#: norms (the per-query LUT carries the reconstruction's norm term),
+#: so its aux block is the 8-row pad-fill carrier only.
+AUX_ROWS: Dict[str, int] = {"int8": 16}
+AUX_ROWS_DEFAULT = 8
+
+#: query operand width per element: the quantized arms stream int8
+#: queries (int4 dbs score against int8 queries — the query side is
+#: tiny, so halving IT buys nothing and would double the query
+#: residual term of the bound).  PQ is absent here too: its query-side
+#: operand is the per-query LUT, priced by :func:`pq_lut_bytes`.
+QUERY_ELEM_BYTES: Dict[str, int] = {"int8": 1, "int4": 1}
+QUERY_ELEM_BYTES_DEFAULT = 4
+
+#: db operand parts per precision for the VMEM launch model:
+#: (n_parts, chunk_w, bytes/elem) — one db block of ONE part occupies
+#: (tile_n, chunk_w) at the part dtype.  int4's packed chunk is 64
+#: bytes wide (two dims per byte over a 128-dim chunk).  "pq" is
+#: absent: its chunk width is the shape-dependent code width
+#: ``ceil(d / dsub)`` (analysis.vmem special-cases it via
+#: :func:`db_row_bytes`).
+DB_PARTS: Dict[str, Tuple[int, int, int]] = {
+    "bf16x3": (2, DIM_CHUNK, 2),
+    "bf16x3f": (1, 3 * DIM_CHUNK, 2),
+    "int8": (1, DIM_CHUNK, 1),
+    "int4": (1, DIM_CHUNK // 2, 1),
+    "highest": (1, DIM_CHUNK, 4),
+    "default": (1, DIM_CHUNK, 4),
+}
+
+#: f32 aux bytes beside each placed row (the hoisted squared norm) —
+#: analysis.hbm's placement arithmetic
+AUX_BYTES_PER_ROW = 4
+
+#: PQ defaults: 4 dims per subspace and 256 codes (one byte) per
+#: codebook — the classic 8-bit PQ point; at SIFT's d=128 a row is 32
+#: code bytes = 1/16 the f32 row
+PQ_DSUB_DEFAULT = 4
+PQ_NCODES_DEFAULT = 256
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+def pq_nsub(d: int, dsub: Optional[int] = None) -> int:
+    """Subspace count ``m = ceil(d / dsub)`` — also the PQ row's code
+    bytes (one uint8 code per subspace)."""
+    return _ceil_div(int(d), int(dsub or PQ_DSUB_DEFAULT))
+
+
+def db_row_bytes(d: int, precision: str, *,
+                 dsub: Optional[int] = None) -> int:
+    """EXACT bytes one db row streams at this precision — the one
+    entry point that covers the shape-dependent arms: int4 rounds the
+    (DIM_CHUNK-padded) dim up to an even nibble pair, PQ streams
+    ``ceil(d / dsub)`` code bytes."""
+    d = int(d)
+    if precision == "pq":
+        return pq_nsub(d, dsub)
+    if precision == "int4":
+        return _ceil_div(_ceil_div(d, DIM_CHUNK) * DIM_CHUNK, 2)
+    if precision not in DB_ELEM_BYTES:
+        raise ValueError(
+            f"precision {precision!r} not in "
+            f"{sorted(DB_ELEM_BYTES) + ['pq']}")
+    return int(d * DB_ELEM_BYTES[precision])
+
+
+def aux_rows_for(precision: str) -> int:
+    return AUX_ROWS.get(precision, AUX_ROWS_DEFAULT)
+
+
+def query_elem_bytes(precision: str) -> int:
+    return QUERY_ELEM_BYTES.get(precision, QUERY_ELEM_BYTES_DEFAULT)
+
+
+def pq_lut_bytes(nq: int, d: int, *, dsub: Optional[int] = None,
+                 ncodes: Optional[int] = None) -> int:
+    """Bytes of the per-query PQ lookup tables one batch carries
+    ([nq, m * ncodes] f32) — the query-side operand of the PQ arm."""
+    m = pq_nsub(d, dsub)
+    return int(nq) * m * int(ncodes or PQ_NCODES_DEFAULT) * 4
+
+
+def pq_lut_flops(nq: int, d: int, *, dsub: Optional[int] = None,
+                 ncodes: Optional[int] = None) -> float:
+    """FLOPs of building the per-query LUTs: every (query, subspace,
+    code) entry is a dsub-dim dot + norm fold, ~2·dsub flops — in total
+    ``2 · nq · ncodes · (m · dsub) >= 2 · nq · ncodes · d``."""
+    m = pq_nsub(d, dsub)
+    return 2.0 * int(nq) * int(ncodes or PQ_NCODES_DEFAULT) * m * int(
+        dsub or PQ_DSUB_DEFAULT)
+
+
+__all__ = [
+    "DIM_CHUNK", "DB_ELEM_BYTES", "AUX_ROWS", "AUX_ROWS_DEFAULT",
+    "QUERY_ELEM_BYTES", "QUERY_ELEM_BYTES_DEFAULT", "DB_PARTS",
+    "AUX_BYTES_PER_ROW", "PQ_DSUB_DEFAULT", "PQ_NCODES_DEFAULT",
+    "pq_nsub", "db_row_bytes", "aux_rows_for", "query_elem_bytes",
+    "pq_lut_bytes", "pq_lut_flops",
+]

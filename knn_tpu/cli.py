@@ -431,8 +431,8 @@ def build_roofline_parser() -> argparse.ArgumentParser:
                    f"{', '.join(sorted(PEAKS_BY_KIND))}; unset/unknown "
                    "= generic-CPU fallback peaks flagged estimated")
     p.add_argument("--precision", default=None,
-                   choices=("bf16x3", "bf16x3f", "int8", "highest",
-                            "default"),
+                   choices=("bf16x3", "bf16x3f", "int8", "int4", "pq",
+                            "highest", "default"),
                    help="kernel matmul precision (pallas selector)")
     p.add_argument("--kernel", default=None,
                    choices=("tiled", "streaming", "fused"))
@@ -459,6 +459,13 @@ def build_roofline_parser() -> argparse.ArgumentParser:
                    "renders the probed-bytes term)")
     p.add_argument("--ncentroids", type=int, default=None,
                    help="IVF list count (required with --nprobe)")
+    p.add_argument("--pq-dsub", type=int, default=None,
+                   help="PQ dims per subspace (--precision pq; "
+                   "default 4) — the row's code bytes are "
+                   "ceil(dim/dsub)")
+    p.add_argument("--pq-ncodes", type=int, default=None,
+                   help="PQ codewords per subspace codebook "
+                   "(--precision pq; default 256)")
     p.add_argument("--best", nargs="?", const=10, type=int, default=None,
                    metavar="N",
                    help="rank the FULL autotuner knob grid by modeled "
@@ -505,7 +512,8 @@ def _run_roofline_best(args) -> int:
                 tile_n=knobs["tile_n"], block_q=knobs["block_q"],
                 survivors=knobs["survivors"], margin=args.margin,
                 device_kind=args.device_kind, num_devices=args.devices,
-                nprobe=args.nprobe, ncentroids=args.ncentroids)
+                nprobe=args.nprobe, ncentroids=args.ncentroids,
+                pq_dsub=args.pq_dsub, pq_ncodes=args.pq_ncodes)
         except ValueError:
             continue  # a combination the model refuses
         if not model.get("ceiling_qps"):
@@ -567,7 +575,8 @@ def run_roofline(args: argparse.Namespace) -> int:
             tile_n=args.tile_n, block_q=args.block_q,
             survivors=args.survivors, margin=args.margin,
             device_kind=args.device_kind, num_devices=args.devices,
-            nprobe=args.nprobe, ncentroids=args.ncentroids)
+            nprobe=args.nprobe, ncentroids=args.ncentroids,
+            pq_dsub=args.pq_dsub, pq_ncodes=args.pq_ncodes)
     else:
         model = roofline.xla_cost_model(
             n=args.n, d=args.dim, k=args.k, nq=args.nq,

@@ -65,6 +65,7 @@ import re
 import threading
 from typing import Dict, Optional, Tuple
 
+from knn_tpu.analysis import widths as _widths
 from knn_tpu.obs import names, registry, trace
 
 #: bump when the model's terms/peaks/output schema change: the tuning
@@ -107,7 +108,21 @@ from knn_tpu.obs import names, registry, trace
 #: entry covers a pruned stream yet — an explicit absent verdict beats
 #: mis-scaling) and the bump re-keys the tuning cache and calibration
 #: store so pre-IVF attributions self-invalidate.
-MODEL_VERSION = 5
+#: 6 = the sub-int8 byte widths (PR 17): the per-precision width
+#: tables move to :mod:`knn_tpu.analysis.widths` (ONE shared home with
+#: analysis.vmem / analysis.hbm) and the model prices the new arms —
+#: "int4" streams nibble-packed rows at 0.5 B/elem (db_row_bytes
+#: rounds the DIM_CHUNK-padded dim to whole bytes) and scores at the
+#: int8 MXU rate; "pq" streams ``ceil(d / dsub)`` code bytes per row,
+#: re-fetches the per-query [nq, m·ncodes] f32 LUT per db tile in
+#: place of the query blocks, and its executed MXU flops are the
+#: one-hot expansion dot the kernel actually runs
+#: (``2·nq·n·m·ncodes``) plus the LUT build — honestly mxu-heavy,
+#: which is why PQ's win is the byte term and its natural home is the
+#: IVF composition (probed blocks gather PQ codes).  The bump re-keys
+#: the tuning cache and calibration store so v5 attributions
+#: self-invalidate.
+MODEL_VERSION = 6
 
 #: the resources a config can exhaust, in tie-break order (dcn_bound
 #: only appears on multi-host blocks, db_hosts > 1)
@@ -187,31 +202,37 @@ def dcn_gbps_for(device_kind, peaks) -> float:
     return DCN_GBPS_BY_KIND.get(device_kind or "", DCN_GBPS_DEFAULT)
 
 #: db operand stream width per element, by kernel matmul precision —
-#: EXACTLY what ops.pallas_knn._bin_candidates builds: bf16x3 streams
-#: precomputed bf16 hi+lo parts (2+2 B), bf16x3f one 3x-wide bf16
-#: contraction (6 B), int8 the quantized rows (1 B), f32 paths the raw
-#: rows (4 B).  tests/test_roofline.py pins these against the actual
-#: operand arrays' nbytes.
-DB_ELEM_BYTES: Dict[str, int] = {
-    "bf16x3": 4, "bf16x3f": 6, "int8": 1, "highest": 4, "default": 4,
-}
+#: EXACTLY what ops.pallas_knn._bin_candidates builds, living since
+#: MODEL_VERSION 6 in the ONE shared width table
+#: (:mod:`knn_tpu.analysis.widths`) so the cost model, the VMEM launch
+#: budget, and the HBM placement budget can never drift.  These names
+#: are VIEWS of that table (``is``-identity, pinned by
+#: tests/test_analysis.py); tests/test_roofline.py additionally pins
+#: them against the actual operand arrays' nbytes.
+DB_ELEM_BYTES = _widths.DB_ELEM_BYTES
 
-#: f32 sublane rows of the per-tile aux block (norms; int8 stacks
+#: f32 sublane rows of the per-tile aux block (norms; int8/int4 stack
 #: scales under norms) — ops.pallas_knn's aux_rows
-AUX_ROWS: Dict[str, int] = {"int8": 16}
-AUX_ROWS_DEFAULT = 8
+AUX_ROWS = _widths.AUX_ROWS
+AUX_ROWS_DEFAULT = _widths.AUX_ROWS_DEFAULT
 
-#: query operand width per element (int8 queries quantize in the XLA
-#: prologue and stream as int8 + a [block_q, 128] f32 scale block)
-QUERY_ELEM_BYTES: Dict[str, int] = {"int8": 1}
-QUERY_ELEM_BYTES_DEFAULT = 4
+#: query operand width per element (int8/int4 queries quantize in the
+#: XLA prologue and stream as int8 + a [block_q, 128] f32 scale block;
+#: pq's query-side operand is the per-query LUT — pq_lut_bytes)
+QUERY_ELEM_BYTES = _widths.QUERY_ELEM_BYTES
+QUERY_ELEM_BYTES_DEFAULT = _widths.QUERY_ELEM_BYTES_DEFAULT
 
 #: executed MXU passes over the 2·nq·n·d useful flops, by precision:
 #: bf16x3/bf16x3f reconstruct the f32 product in three bf16 passes,
-#: "highest" is the native six-pass f32 path, int8 and "default" are
-#: one pass (int8 at the int8 rate)
+#: "highest" is the native six-pass f32 path, int8/int4 and "default"
+#: are one pass (int8/int4 at the int8 MXU rate — int4 unpacks to int8
+#: operands in the kernel prologue).  "pq" is nominally one pass but
+#: its executed flops are shape-dependent (the one-hot dot's
+#: ``m·ncodes`` contraction width) — pallas_cost_model prices that
+#: directly.
 MXU_PASSES: Dict[str, int] = {
     "bf16x3": 3, "bf16x3f": 3, "highest": 6, "default": 1, "int8": 1,
+    "int4": 1, "pq": 1,
 }
 
 #: VPU element-ops per score element for the in-kernel selects — the
@@ -235,7 +256,7 @@ TILE_N_DEFAULT = 16384
 BLOCK_Q_DEFAULT = 128
 BIN_W = 128
 SURVIVORS_GROUPED_DEFAULT = 2
-DIM_CHUNK = 128
+DIM_CHUNK = _widths.DIM_CHUNK
 #: mirror of ops.pallas_knn.MAX_CARRY_DEPTH (pinned by the same test):
 #: past ceil((k+margin+2)/128) carry stats per lane the fused kernel
 #: DISARMS its early-out and runs the plain serialized streaming path,
@@ -283,17 +304,19 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-int(a) // int(b))
 
 
-def db_operand_nbytes(n: int, d: int, precision: str) -> Dict[str, int]:
+def db_operand_nbytes(n: int, d: int, precision: str, *,
+                      dsub: Optional[int] = None) -> Dict[str, int]:
     """Bytes of the db-side operands ONE full-db stream moves — the
     values array(s) plus the lane-major aux block — matching the arrays
     ``ops.pallas_knn._bin_candidates`` actually builds (the property
-    test compares against their ``nbytes``)."""
-    if precision not in DB_ELEM_BYTES:
-        raise ValueError(
-            f"precision {precision!r} not in {sorted(DB_ELEM_BYTES)}")
+    test compares against their ``nbytes``).  The shape-dependent arms
+    route through ``widths.db_row_bytes``: int4 streams the nibble-
+    packed (DIM_CHUNK-padded) rows at 0.5 B/elem, "pq" streams
+    ``ceil(d / dsub)`` code bytes per row."""
     return {
-        "db_values": int(n) * int(d) * DB_ELEM_BYTES[precision],
-        "db_aux": int(n) * AUX_ROWS.get(precision, AUX_ROWS_DEFAULT) * 4,
+        "db_values": int(n) * _widths.db_row_bytes(d, precision,
+                                                   dsub=dsub),
+        "db_aux": int(n) * _widths.aux_rows_for(precision) * 4,
     }
 
 
@@ -478,6 +501,7 @@ def pallas_cost_model(
     num_devices: int = 1, peaks: Optional[Dict[str, float]] = None,
     db_hosts: int = 1, dcn_merge: Optional[str] = None,
     nprobe: Optional[int] = None, ncentroids: Optional[int] = None,
+    pq_dsub: Optional[int] = None, pq_ncodes: Optional[int] = None,
 ) -> dict:
     """The roofline model of one Pallas-selector config (see module
     docstring for the terms).  ``None`` knobs take the library defaults
@@ -490,7 +514,12 @@ def pallas_cost_model(
     (None = the measured crossover pick), serialized after the
     per-host compute.  ``nprobe``/``ncentroids`` (MODEL_VERSION 5)
     scale the streamed rows by the expected probe fraction and add the
-    centroid-scan term (``_probe_setup``)."""
+    centroid-scan term (``_probe_setup``).  ``pq_dsub``/``pq_ncodes``
+    (MODEL_VERSION 6) size the "pq" arm's codebook geometry — ignored
+    by every other precision; None takes the widths defaults (4, 256).
+    The two knob pairs COMPOSE: a probed pq block streams
+    ``probe_fraction × ceil(d/dsub)`` code bytes per row, the two byte
+    reductions multiplying."""
     precision = precision or "bf16x3"
     kernel = kernel or "tiled"
     if kernel not in ("tiled", "streaming", "fused"):
@@ -532,15 +561,24 @@ def pallas_cost_model(
         db_passes = 1
     else:
         db_passes = q_blocks
-    opnd = db_operand_nbytes(n_dev, d, precision)
+    eff_dsub = int(pq_dsub or _widths.PQ_DSUB_DEFAULT)
+    eff_ncodes = int(pq_ncodes or _widths.PQ_NCODES_DEFAULT)
+    opnd = db_operand_nbytes(n_dev, d, precision, dsub=eff_dsub)
     db_stream = db_passes * opnd["db_values"]
     db_aux = db_passes * opnd["db_aux"]
     # query blocks re-fetch once per db tile (their mapped index cycles
-    # with the dim-chunk axis); int8 adds the [block_q, 128] f32
-    # per-query scale block per cell
-    q_elem = QUERY_ELEM_BYTES.get(precision, QUERY_ELEM_BYTES_DEFAULT)
-    queries_b = n_tiles * nq * d * q_elem
-    if precision == "int8":
+    # with the dim-chunk axis); int8/int4 add the [block_q, 128] f32
+    # per-query scale block per cell; pq's query-side operand is the
+    # per-query LUT ([nq, m·ncodes] f32), re-fetched per db tile in
+    # place of the raw query blocks (the raw queries are consumed ONCE
+    # by the XLA LUT prologue)
+    if precision == "pq":
+        queries_b = n_tiles * _widths.pq_lut_bytes(
+            nq, d, dsub=eff_dsub, ncodes=eff_ncodes) + nq * d * 4
+    else:
+        q_elem = QUERY_ELEM_BYTES.get(precision, QUERY_ELEM_BYTES_DEFAULT)
+        queries_b = n_tiles * nq * d * q_elem
+    if precision in ("int8", "int4"):
         queries_b += n_tiles * nq * BIN_W * 4
     # candidate outputs: every (query block, db tile) cell writes its
     # disjoint (block_q, out_w) f32+i32 candidates and bound_w bounds
@@ -555,12 +593,24 @@ def pallas_cost_model(
     # --- MXU flops ------------------------------------------------------
     useful = 2.0 * nq * n * d
     passes = MXU_PASSES[precision]
-    executed = useful * passes
+    if precision == "pq":
+        # the kernel's one dense dot contracts over the one-hot
+        # expansion's m·ncodes width (ops.pallas_knn._pq_onehot_qt),
+        # not d — plus the per-query LUT build in the XLA prologue.
+        # Honest and mxu-heavy: PQ's win is the BYTE term, and the
+        # model says so rather than pricing a gather kernel it does
+        # not run.
+        m_sub = _widths.pq_nsub(d, eff_dsub)
+        lut_flops = _widths.pq_lut_flops(nq, d, dsub=eff_dsub,
+                                         ncodes=eff_ncodes)
+        executed = 2.0 * nq * n * (m_sub * eff_ncodes) + lut_flops
+    else:
+        executed = useful * passes
     if probe is not None:
         useful += probe["assign_flops"]
         executed += probe["assign_flops"]
-    mxu_rate = peaks["int8_flops"] if precision == "int8" else \
-        peaks["bf16_flops"]
+    mxu_rate = peaks["int8_flops"] if precision in ("int8", "int4") \
+        else peaks["bf16_flops"]
     # executed flops are per-device work summed over the (perfectly
     # scaled) mesh: each device runs executed/num_devices in parallel
     t_mxu = executed / max(1, int(num_devices)) / mxu_rate
@@ -605,6 +655,12 @@ def pallas_cost_model(
             },
         },
     }
+    if precision == "pq":
+        model["config"]["pq_dsub"] = eff_dsub
+        model["config"]["pq_ncodes"] = eff_ncodes
+        model["terms"]["mxu"]["pq_onehot_width"] = int(
+            _widths.pq_nsub(d, eff_dsub) * eff_ncodes)
+        model["terms"]["mxu"]["pq_lut_flops"] = float(lut_flops)
     if probe is not None:
         model["config"]["nprobe"] = probe["nprobe"]
         model["config"]["ncentroids"] = probe["ncentroids"]

@@ -155,10 +155,26 @@ _I32MAX = jnp.iinfo(jnp.int32).max
 #: throughput, 1/4 the db streaming bytes) rescaled to f32 by the
 #: per-query x per-row scale product — its certified tolerance is the
 #: PROVABLE per-query quantization bound ε (quantize.score_error_bound),
-#: so misses fall back, never leak.  "highest" is the native f32 path;
+#: so misses fall back, never leak.  "int4" takes that one rung further
+#: down the byte ladder (PR 17): the db streams 4-bit rows packed
+#: two-nibbles-per-byte (ops.quantize.pack_nibbles — 0.5 B/elem, HALF
+#: int8's stream), unpacked in the kernel prologue into int8 lanes and
+#: scored against the SAME int8 queries with the same exact-int32
+#: accumulation; only the db residual widens, and the certificate's ε
+#: widens with it through the identical actual-residual bound.  "pq"
+#: drops below bits-per-dim entirely: product-quantization codes (one
+#: byte per ``dsub``-dim subspace, ops.pq) stream as the db operand and
+#: the query side arrives as a per-query LOOKUP TABLE
+#: (LUT[q, s*C + c] = q_s·cb[s,c] - ||cb[s,c]||²/2) so the kernel's
+#: score is one dense MXU dot of the LUT against a one-hot code
+#: expansion — s = tn - 2·qt then equals ||t̂||² - 2 q·t̂, the exact
+#: kernel score against the RECONSTRUCTION t̂, and the per-subspace
+#: Cauchy–Schwarz bound (ops.pq.score_error_bound_pq) certifies the
+#: distance to the true rows.  "highest" is the native f32 path;
 #: "default" is for experiments only — its error is certificate-hostile
 #: (~2^-10 relative, measured).
-PRECISIONS = ("bf16x3", "bf16x3f", "int8", "highest", "default")
+PRECISIONS = ("bf16x3", "bf16x3f", "int8", "int4", "pq", "highest",
+              "default")
 
 #: kernel/emitter code version: BUMP whenever the kernel arithmetic, the
 #: emitters, or the knob semantics change — the autotuner's persisted
@@ -167,8 +183,10 @@ PRECISIONS = ("bf16x3", "bf16x3f", "int8", "highest", "default")
 #: a changed kernel.  3 = int8 emitter path added (PR 3); 4 = fused
 #: in-loop select arm + the r05-proven block_q=256 default promotion
 #: (tuning.DEFAULT_KNOBS) — old winners measured against block_q=128
-#: reference runs self-invalidate.
-KERNEL_VERSION = 4
+#: reference runs self-invalidate.  5 = sub-int8 arms (int4 nibble
+#: unpack prologue + PQ LUT/one-hot scoring, PR 17): the precision knob
+#: domain widened, so winners tuned on the v4 grid self-invalidate.
+KERNEL_VERSION = 5
 
 #: relative slack of the device rank stage's direct-difference f32
 #: distances: per-term (q-t)^2 rounding plus the depth-7 tree reduce give
@@ -328,9 +346,45 @@ def effective_tile(
     return eff
 
 
+def _unpack_nibble_chunk(tb):
+    """Kernel-prologue unpack of one packed int4 db chunk block
+    ([T, 64] uint8 -> [T, 128] int8): the chunk-paired layout
+    (ops.quantize.pack_nibbles) puts dims [0, 64) of the 128-dim chunk
+    in the low nibbles and [64, 128) in the high nibbles of the SAME
+    bytes, so two vectorized mask/shift ops plus one lane-axis concat
+    reassemble the chunk in dim order — no element interleave, no
+    gather.  Biased +8 at pack time, un-biased here."""
+    lo = (tb & 0xF).astype(jnp.int8) - 8
+    hi = (tb >> 4).astype(jnp.int8) - 8
+    return jnp.concatenate([lo, hi], axis=1)
+
+
+def _pq_onehot_qt(lut, codes_u8, *, tile_n: int, pq_shape):
+    """The PQ scoring dot shared by the tiled and streaming kernels —
+    ONE arithmetic, which the bitwise contract across db-streaming
+    strategies rests on.  ``lut`` [BQ, >= m*C] per-query tables
+    (LUT[q, s*C + c] = q_s·cb[s,c] - ||cb[s,c]||²/2, built once in the
+    XLA prologue), ``codes_u8`` [T, m] the streamed byte codes.  The
+    gather of m table entries per row becomes a dense MXU matmul of the
+    LUT against the codes' one-hot expansion: qt[q, t] =
+    sum_s LUT[q, s*C + codes[t, s]] = q·t̂ - ||t̂||²/2, so the shared
+    emitters' ``s = tn - 2·qt`` (tn = 0 on valid rows, PAD_VAL on
+    padding) equals ||t̂||² - 2 q·t̂ — the standard kernel score against
+    the reconstruction t̂."""
+    m_sub, ncodes = pq_shape
+    codes = codes_u8.astype(jnp.int32)
+    cidx = lax.broadcasted_iota(jnp.int32, (tile_n, m_sub, ncodes), 2)
+    onehot = (codes[:, :, None] == cidx).astype(jnp.float32).reshape(
+        tile_n, m_sub * ncodes)
+    dn = (((1,), (1,)), ((), ()))
+    return lax.dot_general(lut[:, : m_sub * ncodes], onehot, dn,
+                           preferred_element_type=jnp.float32)
+
+
 def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
             survivors: int, out_w: int, bound_w: int, nd: int,
-            precision: str, binning: str, ti_axis: int = 1):
+            precision: str, binning: str, ti_axis: int = 1,
+            pq_shape=None):
     ti = pl.program_id(ti_axis)  # 1 = query_major grid, 0 = db_major
     di = pl.program_id(2)
     q = q_ref[:]
@@ -375,6 +429,27 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
         tn_ref = aux_ref
         qt = lax.dot_general(q, ti_ref[:], dn,
                              preferred_element_type=jnp.int32)
+    elif precision == "int4":
+        # the int8 path one rung down: the db chunk arrives PACKED
+        # ([T, 64] uint8, two 4-bit dims per byte) and unpacks here into
+        # int8 lanes; queries are the SAME int8 quantization as the int8
+        # arm, so the dot is the identical exact-int32 accumulation
+        # (|qi·ti| <= 127·7·d — overflow-free far past any real dim) and
+        # the one f32 rescale at select time is shared with int8
+        ti_ref, qsc_ref, aux_ref, d_ref, i_ref, b_ref, *scratch = refs
+        tn_ref = aux_ref
+        qt = lax.dot_general(q, _unpack_nibble_chunk(ti_ref[:]), dn,
+                             preferred_element_type=jnp.int32)
+    elif precision == "pq":
+        # product-quantization scoring: q_ref carries the per-query LUT
+        # block (one block, nd == 1 always), the db operand is the byte
+        # code tile — _pq_onehot_qt turns the per-row table gather into
+        # one dense MXU dot.  The aux block is the pad-fill carrier only
+        # (0 on valid rows: the LUT already embeds the reconstruction's
+        # norm term)
+        codes_ref, tn_ref, d_ref, i_ref, b_ref, *scratch = refs
+        qt = _pq_onehot_qt(q, codes_ref[:], tile_n=tile_n,
+                           pq_shape=pq_shape)
     else:
         t_ref, tn_ref, d_ref, i_ref, b_ref, *scratch = refs
         prec = (lax.Precision.HIGHEST if precision == "highest"
@@ -388,12 +463,17 @@ def _kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
     emit = _emit_select_grouped if binning == "grouped" else _emit_select
 
     def write(qt_acc):
-        if precision == "int8":
+        if precision in ("int8", "int4"):
             # the one rescale: full int32 dot -> f32 (rounded for
             # d > 1040, covered by the bound's f32 slack), times the
-            # per-query [BQ, 1] and per-row [1, T] scales
+            # per-query [BQ, 1] and per-row [1, T] scales.  int8's aux
+            # stacks 8 norm rows over 8 scale rows (scales at row 8);
+            # int4 packs norms (row 0) + scales (row 1) into ONE 8-row
+            # block — half the aux stream, which is what lets its db
+            # side hit the 2x-under-int8 byte budget the roofline pins
+            scale_row = 8 if precision == "int8" else 1
             qt_acc = ((qt_acc.astype(jnp.float32) * qsc_ref[:, 0:1])
-                      * aux_ref[8:9, :])
+                      * aux_ref[scale_row:scale_row + 1, :])
         cd, ci, bound = emit(
             ti, qt_acc, tn_ref[:], tile_n=tile_n, bin_w=bin_w,
             n_bins=n_bins, survivors=survivors, out_w=out_w,
@@ -534,7 +614,7 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
                    survivors: int, out_w: int, bound_w: int, n_tiles: int,
                    nd: int, precision: str, binning: str, n_parts: int,
                    chunk_w: int, aux_rows: int = 8, fused: bool = False,
-                   keep: Optional[int] = None):
+                   keep: Optional[int] = None, pq_shape=None):
     """One launch per (batch, shard): the db-side arrays stay in HBM and
     stream tile-by-tile through TWO VMEM scratch slots via explicit
     async copies — tile i+1's HBM->VMEM copy overlaps tile i's MXU
@@ -557,7 +637,7 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
       sem                           DMA semaphores (2, n_parts + 1)
     """
     qsc_ref = None
-    if precision == "int8":
+    if precision in ("int8", "int4"):
         qsc_ref, refs = refs[0], refs[1:]
     parts_hbm = refs[:n_parts]
     tn_hbm = refs[n_parts]
@@ -596,10 +676,20 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
         identical, which the bitwise contract rests on).  int8 returns
         the raw int32 partial dot (exact integer accumulation; the one
         f32 rescale happens at emit time, like the tiled kernel)."""
+        if precision == "pq":
+            # nd == 1 always: the whole per-query LUT block scores the
+            # streamed byte-code tile in one shared dot
+            codes_buf, = bufs
+            return _pq_onehot_qt(q, codes_buf, tile_n=tile_n,
+                                 pq_shape=pq_shape)
         qc = q[:, c * DIM_CHUNK : (c + 1) * DIM_CHUNK]
         if precision == "int8":
             t, = bufs
             return lax.dot_general(qc, t, dn,
+                                   preferred_element_type=jnp.int32)
+        if precision == "int4":
+            t, = bufs  # [tile_n, 64] packed uint8 chunk
+            return lax.dot_general(qc, _unpack_nibble_chunk(t), dn,
                                    preferred_element_type=jnp.int32)
         if precision == "bf16x3":
             th, tl = bufs
@@ -660,10 +750,14 @@ def _stream_kernel(q_ref, *refs, tile_n: int, bin_w: int, n_bins: int,
             # (int8: exact int32 adds — order-independent by construction)
             qt = qt_c if qt is None else qt + qt_c
         tn_dma(ti, ti % 2).wait()
-        if precision == "int8":
-            # the one f32 rescale, same op sequence as the tiled write()
+        if precision in ("int8", "int4"):
+            # the one f32 rescale, same op sequence as the tiled
+            # write() — including the per-precision scale row (int8:
+            # row 8 of the 16-row stacked aux; int4: row 1 of its
+            # packed 8-row aux)
+            scale_row = 8 if precision == "int8" else 1
             qt = ((qt.astype(jnp.float32) * qsc_ref[:, 0:1])
-                  * tn_buf[ti % 2][8:9, :])
+                  * tn_buf[ti % 2][scale_row:scale_row + 1, :])
         off = pl.multiple_of(ti * out_w, out_w)
         boff = pl.multiple_of(ti * bound_w, bound_w)
         if not armed:
@@ -775,6 +869,8 @@ def _bin_candidates(
     db_int8: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     offset: float = 0.0,
     keep: Optional[int] = None,
+    db_int4: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    db_pq: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Kernel launch on padded shapes.  Returns
 
@@ -797,9 +893,24 @@ def _bin_candidates(
     ``db_int8=(values int8 [N,D], scales f32 [N], row_norms f32 [N])``
     — the ShardedKNN placement path, where the f32 db never re-streams
     for the coarse pass.  ``offset`` is the translation-invariance shift
-    both sides subtract before quantizing (128.0 for bvecs payloads)."""
+    both sides subtract before quantizing (128.0 for bvecs payloads).
+
+    ``precision="int4"`` mirrors the int8 contract one byte-width rung
+    down: ``db_int4=(packed uint8 [N, ceil(D, 128)/2], scales f32 [N],
+    row_norms f32 [N])`` streams nibble-packed rows unpacked in the
+    kernel prologue (``db_int4=None`` quantizes + packs here).  Queries
+    stay int8 — their bytes are negligible and halving them would only
+    widen the certificate's query-residual terms.
+
+    ``precision="pq"`` REQUIRES ``db_pq=(codes uint8 [N, m], codebooks
+    f32 [m, C, dsub])`` (codebooks train on data — ops.pq.train_pq;
+    there is no quantize-on-the-fly arm).  The query operand becomes
+    the per-query LUT built in the XLA prologue; scores are against the
+    RECONSTRUCTION t̂ (see ``_pq_onehot_qt``), certified by the
+    per-subspace bound in ops.pq."""
     queries = _pad_axis(queries.astype(jnp.float32), block_q, 0)
     queries = _pad_axis(queries, DIM_CHUNK, 1)
+    n_rows = db.shape[0]
     db = _pad_axis(db.astype(jnp.float32), tile_n, 0, fill=PAD_VAL)
     db = _pad_axis(db, DIM_CHUNK, 1)
     qp, dim = queries.shape
@@ -829,6 +940,21 @@ def _bin_candidates(
         raise ValueError(
             "kernel='fused' requires binning='grouped' (the early-out "
             "carry is per-lane)")
+    if kernel == "fused" and precision == "pq":
+        # the fused early-out's bitwise argument (a skipped tile's
+        # scores all strictly exceed an upper bound on the final
+        # (m+2)-th smallest EMITTED candidate) was established for the
+        # tn - 2·qt score pipeline whose emitted values the carry
+        # tracks.  PQ's scores are against the RECONSTRUCTION t̂, and
+        # its certificate separately bounds the true-row distance — the
+        # carry-soundness argument has NOT been extended to compose
+        # with that second bound, so the fused arm refuses rather than
+        # ship an unproven skip predicate.  Use kernel="streaming".
+        raise ValueError(
+            "kernel='fused' is not certified for precision='pq': the "
+            "early-out carry-soundness argument has not been extended "
+            "to reconstruction-space scores; use 'streaming' or 'tiled'")
+    pq_shape = None
     queries_in = queries
     q_extra = []  # int8: the per-query-row scale block rides as an input
     aux_rows = 8
@@ -878,6 +1004,78 @@ def _bin_candidates(
         # 0-7 tn broadcast, 8-15 scales broadcast) so BOTH stream through
         # the one lane-major aux slot the f32 path already has
         aux_rows = 16
+    elif precision == "int4":
+        from knn_tpu.ops.quantize import (pack_nibbles_t, quantize_rows,
+                                          quantize_rows_int4)
+
+        # queries: the SAME int8 quantization as the int8 arm (the
+        # certificate's query residual terms are computed against it)
+        qi, qsc = quantize_rows(queries - offset)
+        queries_in = qi
+        q_extra = [jnp.broadcast_to(qsc[:, None], (qp, BIN_W))]
+        if db_int4 is None:
+            db_sh = db - offset
+            tq, ts = quantize_rows_int4(db_sh)
+            tp = pack_nibbles_t(tq)
+            tn_rows = jnp.sum(db_sh * db_sh, axis=-1)
+        else:
+            tp, ts, tn_rows = db_int4
+            # same pre-quantized padding contract as int8: zero packed
+            # bytes at zero scale dequantize harmlessly, PAD_VAL norms
+            # keep pads out of every bin
+            tp = _pad_axis(tp, tile_n, 0)
+            tp = _pad_axis(tp, DIM_CHUNK // 2, 1)
+            ts = _pad_axis(ts[:, None], tile_n, 0)[:, 0]
+            tn_rows = _pad_axis(tn_rows[:, None], tile_n, 0,
+                                fill=PAD_VAL)[:, 0]
+        db_inputs = [tp]
+        # the packed chunk is HALF a dim chunk of bytes: the layout
+        # pairs dims c*128+j / c*128+64+j in one byte, so chunk c of
+        # the feature axis is exactly packed columns [c*64, (c+1)*64)
+        chunk_w = DIM_CHUNK // 2
+        # unlike int8 (16 rows: norms broadcast over scales broadcast),
+        # int4 packs norms at row 0 and scales at row 1 of the DEFAULT
+        # 8-row aux block: the kernel reads exactly one row of each, so
+        # the broadcast buys nothing and the packed layout halves the
+        # aux stream — without it the [16, N] aux would weigh as much
+        # as the nibble-packed values themselves at d=128
+        aux_rows = 8
+    elif precision == "pq":
+        if db_pq is None:
+            raise ValueError(
+                "precision='pq' requires db_pq=(codes, codebooks): PQ "
+                "codebooks train on data (ops.pq.train_pq) — there is "
+                "no quantize-on-the-fly arm")
+        codes, books = db_pq
+        m_sub, ncodes, dsub = books.shape
+        pq_shape = (m_sub, ncodes)
+        # per-query LUT prologue (the PQ analogue of the bf16 split /
+        # int8 quantization prologues): queries zero-pad to the trained
+        # m*dsub width — zero-padding is exactly how the codebooks were
+        # trained, so the subspace split matches
+        qv = queries
+        if qv.shape[1] < m_sub * dsub:
+            qv = jnp.pad(qv, ((0, 0), (0, m_sub * dsub - qv.shape[1])))
+        qv = qv[:, : m_sub * dsub].reshape(qp, m_sub, dsub)
+        lut = (jnp.einsum("qmd,mcd->qmc", qv, books)
+               - 0.5 * jnp.sum(books * books, axis=-1)[None])
+        queries_in = _pad_axis(
+            lut.reshape(qp, m_sub * ncodes).astype(jnp.float32), BIN_W, 1)
+        if codes.shape[0] != n_rows:
+            raise ValueError(
+                f"db_pq codes rows ({codes.shape[0]}) do not match the "
+                f"db rows ({n_rows}) the rescore gathers from")
+        tn_rows = jnp.zeros((codes.shape[0],), jnp.float32)
+        codes = _pad_axis(codes, tile_n, 0)
+        tn_rows = _pad_axis(tn_rows[:, None], tile_n, 0,
+                            fill=PAD_VAL)[:, 0]
+        db_inputs = [codes]
+        # NOTE: the streamed code block is [tile_n, m] uint8 — at small
+        # m this is narrower than the 128-lane tile; fine in interpret
+        # mode, and the compiled-mode geometry goes through the same
+        # on-hardware gate every new arm goes through before promotion
+        chunk_w = m_sub
+        nd = 1  # the LUT scores in ONE dot; there is no dim-chunk loop
     else:
         db_inputs = [db]
         chunk_w = DIM_CHUNK
@@ -887,6 +1085,18 @@ def _bin_candidates(
             jnp.broadcast_to(ts[None, :].astype(jnp.float32),
                              (8, db.shape[0])),
         ], axis=0)
+    elif precision == "int4":
+        # norms row 0, scales row 1, zero fill rows 2-7: one 8-row aux
+        # block instead of int8's 16 (the kernel reads one row of each)
+        tnorm = jnp.concatenate([
+            tn_rows[None, :],
+            ts[None, :].astype(jnp.float32),
+            jnp.zeros((6, db.shape[0]), jnp.float32),
+        ], axis=0)
+    elif precision == "pq":
+        # pad-fill carrier only: 0 on valid rows (the LUT carries the
+        # reconstruction norm term), PAD_VAL on tile padding
+        tnorm = jnp.broadcast_to(tn_rows[None, :], (8, db.shape[0]))
     else:
         # full-dim db row norms, f32, broadcast to 8 sublanes so the
         # kernel reads them as a lane-major [8, tile_n] block
@@ -901,13 +1111,14 @@ def _bin_candidates(
 
     if kernel in ("streaming", "fused"):
         return _stream_call(
-            queries_in, db_inputs, tnorm, out_shape, qp=qp, dim=dim,
+            queries_in, db_inputs, tnorm, out_shape, qp=qp,
+            dim=queries_in.shape[1],
             block_q=block_q, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
             survivors=survivors, out_w=out_w, bound_w=bound_w,
             n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
             chunk_w=chunk_w, interpret=interpret,
             q_extra=q_extra, aux_rows=aux_rows,
-            fused=kernel == "fused", keep=keep,
+            fused=kernel == "fused", keep=keep, pq_shape=pq_shape,
         )
 
     db_major = grid_order == "db_major"
@@ -915,8 +1126,12 @@ def _bin_candidates(
         _kernel, tile_n=tile_n, bin_w=bin_w, n_bins=n_bins,
         survivors=survivors, out_w=out_w, bound_w=bound_w, nd=nd,
         precision=precision, binning=binning,
-        ti_axis=0 if db_major else 1,
+        ti_axis=0 if db_major else 1, pq_shape=pq_shape,
     )
+    # the query operand block: one DIM_CHUNK slice per grid step for the
+    # feature-chunked arms; PQ's LUT has no chunk loop (nd == 1) and
+    # rides as ONE lane-padded block
+    q_block_w = queries_in.shape[1] if precision == "pq" else DIM_CHUNK
     if db_major:
         grid = (n_tiles, qp // block_q, nd)
         q_idx = lambda t, q, d: (q, d)      # noqa: E731
@@ -957,7 +1172,7 @@ def _bin_candidates(
         body,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block_q, DIM_CHUNK), q_idx),
+            pl.BlockSpec((block_q, q_block_w), q_idx),
             *db_specs,
             *extra_specs,
             pl.BlockSpec((aux_rows, tile_n), n_idx),
@@ -975,7 +1190,8 @@ def _bin_candidates(
         # the f32 paths accumulate the scaled f32 score
         scratch_shapes=[] if nd == 1 else [
             pltpu.VMEM((block_q, tile_n),
-                       jnp.int32 if precision == "int8" else jnp.float32),
+                       jnp.int32 if precision in ("int8", "int4")
+                       else jnp.float32),
         ],
         interpret=interpret,
         **kwargs,
@@ -985,7 +1201,8 @@ def _bin_candidates(
 def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
                  tile_n, bin_w, n_bins, survivors, out_w, bound_w, n_tiles,
                  nd, precision, binning, chunk_w, interpret,
-                 q_extra=(), aux_rows=8, fused=False, keep=None):
+                 q_extra=(), aux_rows=8, fused=False, keep=None,
+                 pq_shape=None):
     """The streaming ``pallas_call``: grid over query blocks only, db
     parts + row norms left in compiler-chosen (HBM) memory and streamed
     by the kernel's own double-buffered DMA loop (``_stream_kernel``).
@@ -1000,7 +1217,7 @@ def _stream_call(queries, db_inputs, tnorm, out_shape, *, qp, dim, block_q,
         survivors=survivors, out_w=out_w, bound_w=bound_w,
         n_tiles=n_tiles, nd=nd, precision=precision, binning=binning,
         n_parts=n_parts, chunk_w=chunk_w, aux_rows=aux_rows,
-        fused=fused, keep=keep,
+        fused=fused, keep=keep, pq_shape=pq_shape,
     )
     any_space = getattr(pltpu, "ANY", None) or pltpu.TPUMemorySpace.ANY
     part_dtype = db_inputs[0].dtype
@@ -1071,6 +1288,8 @@ def local_certified_candidates(
     kernel: str = "tiled",
     db_int8: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     offset: float = 0.0,
+    db_int4: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    db_pq: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The whole device-side certified coarse pass against one db (shard):
 
@@ -1106,7 +1325,12 @@ def local_certified_candidates(
     ``_bin_candidates``); the stage-3 rescore ALWAYS gathers the f32
     ``t`` rows, so the returned d32 values and the near-tie analysis are
     precision-independent — the quantization only steers which
-    candidates surface, never what their distances read."""
+    candidates surface, never what their distances read.  The "int4"
+    and "pq" arms follow the same contract (``db_int4`` / ``db_pq``
+    plug their placements in); the rescore's precision-independence is
+    what makes ALL quantized arms bitwise-equal to the exact reference
+    whenever their candidates cover the true top-k — and certified
+    fallback material otherwise."""
     if interpret is None:
         interpret = not _on_tpu()
     cd, ci, bounds = local_coarse_candidates(
@@ -1114,7 +1338,7 @@ def local_certified_candidates(
         survivors=survivors, precision=precision, interpret=interpret,
         binning=binning, final_select=final_select,
         grid_order=grid_order, kernel=kernel, db_int8=db_int8,
-        offset=offset,
+        offset=offset, db_int4=db_int4, db_pq=db_pq,
     )
     return local_select_rescore(
         q, t, cd, ci, bounds, m, final_select=final_select,
@@ -1145,6 +1369,8 @@ def local_coarse_candidates(
     db_int8: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     offset: float = 0.0,
     final_select: str = "exact",
+    db_int4: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    db_pq: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Stage 1 of :func:`local_certified_candidates` — the db-streaming
     coarse pass alone: resolve the effective tile, launch the kernel,
@@ -1178,6 +1404,7 @@ def local_coarse_candidates(
         interpret=interpret, binning=binning, grid_order=grid_order,
         kernel=kernel, db_int8=db_int8, offset=offset,
         keep=m + 2 if kernel == "fused" else None,
+        db_int4=db_int4, db_pq=db_pq,
     )
     n_q = q.shape[0]
     return cd[:n_q], ci[:n_q], bounds[:n_q]
@@ -1321,23 +1548,33 @@ def kernel_tolerance(
     base = 4.0 * certification_tolerance(
         queries_np, db_np, db_norm_max=db_norm_max, q_norm=q_norm
     )
-    if precision == "int8":
+    if precision in ("int8", "int4"):
         from knn_tpu.ops import quantize as qz
 
         if quant is None:
-            quant = qz.quantize_rows_np(db_np)
+            quant = (qz.quantize_rows_np(db_np) if precision == "int8"
+                     else qz.quantize_rows_int4_np(db_np))
         stats = qz.db_bound_stats(quant, db_np)
         return np.maximum(
             base,
             qz.score_error_bound(queries_np, stats, offset=quant.offset),
         )
+    if precision == "pq":
+        from knn_tpu.ops import pq as pqm
+
+        if quant is None:
+            raise ValueError(
+                "precision='pq' needs quant=<ops.pq.PQResult> (codebooks "
+                "train on data; there is no quantize-on-the-fly arm)")
+        return np.maximum(
+            base, pqm.score_error_bound_pq(queries_np, quant.stats))
     if precision in ("bf16x3", "bf16x3f"):
         return np.maximum(base, 2.0 ** -14 * (q_norm + db_norm_max))
     if precision == "highest":
         return base
     raise ValueError(
         f"precision {precision!r} has no certified tolerance model; "
-        f"use 'bf16x3', 'bf16x3f', 'int8', or 'highest'"
+        f"use 'bf16x3', 'bf16x3f', 'int8', 'int4', 'pq', or 'highest'"
     )
 
 

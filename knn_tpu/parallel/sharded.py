@@ -537,6 +537,11 @@ class ShardedKNN:
         #: row norms + bound consts), cached per instance — "quantize
         #: once at placement time", the int8 arm's whole HBM story
         self._int8_cache = None
+        #: the sub-int8 arms' placements, same lazy discipline: int4 is
+        #: one nibble-packed placement; pq keys a small dict by the
+        #: (dsub, ncodes) codebook geometry so two grids can coexist
+        self._int4_cache = None
+        self._pq_cache: dict = {}
         db_shards = hosts * chips
         pre_placed = (
             isinstance(train, jax.Array)
@@ -1178,16 +1183,123 @@ class ShardedKNN:
                 }
         return self._int8_cache
 
+    def _int4_placement(self) -> dict:
+        """The nibble-packed db placement for the int4 coarse pass —
+        :meth:`_int8_placement` one byte-width rung down, same lazy
+        cache discipline.  Rows quantize per-row symmetric to [-7, 7]
+        (ops.quantize.quantize_rows_int4_np), dims zero-pad to a
+        DIM_CHUNK multiple, then pack two-nibbles-per-byte
+        (ops.quantize.pack_nibbles) — HALF the int8 stream.  The bound
+        machinery is shared VERBATIM with int8: the unpacked int8-range
+        values feed db_bound_stats (actual residuals), so the
+        certificate's ε needs no new derivation.  No uint8 byte-exact
+        shortcut here — bytes don't fit 4 bits."""
+        if self._int4_cache is None:
+            from knn_tpu.ops import quantize as qz
+            from knn_tpu.ops.pallas_knn import DIM_CHUNK, PAD_VAL
+
+            with self._engines_lock:
+                if self._int4_cache is not None:
+                    return self._int4_cache
+                host = self._host_train()
+                qr = qz.quantize_rows_int4_np(host)
+                stats = qz.db_bound_stats(qr, host)
+                rows = self._tp.shape[0]
+                pad = rows - qr.values.shape[0]
+                d = qr.values.shape[1]
+                dpad = -(-d // DIM_CHUNK) * DIM_CHUNK - d
+                # zero-padded dims pack to the biased-zero nibble (8)
+                # and decode back to 0; zero pad ROWS pack to zero
+                # bytes, killed by zero scale + PAD_VAL norm like int8
+                vals = np.pad(qr.values, ((0, pad), (0, dpad)))
+                packed = qz.pack_nibbles(vals)
+                scl = np.pad(qr.scales, (0, pad)).astype(np.float32)
+                tn = np.empty(rows, dtype=np.float32)
+                for lo in range(0, host.shape[0], 65536):
+                    hs = host[lo : lo + 65536].astype(np.float64)
+                    tn[lo : lo + hs.shape[0]] = (hs ** 2).sum(-1)
+                tn[host.shape[0]:] = PAD_VAL
+                self._int4_cache = {
+                    "values": shard(packed, self.mesh, DB_AXIS),
+                    "scales": shard(scl, self.mesh, DB_AXIS),
+                    "norms": shard(tn, self.mesh, DB_AXIS),
+                    "consts": replicate(qz.bound_consts(stats), self.mesh),
+                    "offset": float(qr.offset),
+                    "stats": stats,
+                }
+        return self._int4_cache
+
+    def _pq_placement(self, dsub: Optional[int] = None,
+                      ncodes: Optional[int] = None) -> dict:
+        """The product-quantized db placement for the pq coarse pass:
+        per-subspace codebooks trained ONCE with the IVF tier's seeded
+        deterministic k-means (ops.pq.train_pq) and the corpus encoded
+        as a list-major [N, m] byte tensor — ``ceil(d/dsub)`` B/row.
+        Codes shard along the db axis; the codebooks and the
+        per-subspace bound-consts vector replicate (they are tiny).
+        Cached per (dsub, ncodes) geometry; defaults come from
+        KNN_TPU_PQ_DSUB / KNN_TPU_PQ_NCODES env, else the classic
+        (4, 256) point (analysis.widths)."""
+        import os as _os
+
+        from knn_tpu.analysis import widths
+        from knn_tpu.ops import pq as pqm
+
+        def _env_int(name, fallback):
+            raw = _os.environ.get(name, "").strip()
+            if not raw:
+                return int(fallback)
+            try:
+                return int(raw)
+            except ValueError as e:
+                raise ValueError(f"{name}={raw!r} is not an int") from e
+
+        dsub = int(dsub) if dsub else _env_int(
+            "KNN_TPU_PQ_DSUB", widths.PQ_DSUB_DEFAULT)
+        ncodes = int(ncodes) if ncodes else _env_int(
+            "KNN_TPU_PQ_NCODES", widths.PQ_NCODES_DEFAULT)
+        key = (dsub, ncodes)
+        if key not in self._pq_cache:
+            with self._engines_lock:
+                if key in self._pq_cache:
+                    return self._pq_cache[key]
+                host = self._host_train()
+                res = pqm.train_pq(host, mesh=self.mesh, dsub=dsub,
+                                   ncodes=ncodes)
+                rows = self._tp.shape[0]
+                # zero-code pad rows reconstruct to an ordinary point;
+                # they can transiently occupy candidate slots but the
+                # global-index mask (n_train) keeps them out of every
+                # answer, and any crowding a tiny pad tail causes lands
+                # in the bad-flag -> fallback repair, never silently
+                codes = np.pad(res.codes,
+                               ((0, rows - res.codes.shape[0]), (0, 0)))
+                self._pq_cache[key] = {
+                    "codes": shard(codes, self.mesh, DB_AXIS),
+                    "books": replicate(res.codebooks, self.mesh),
+                    "consts": replicate(pqm.bound_consts_pq(res.stats),
+                                        self.mesh),
+                    "stats": res.stats,
+                    "dsub": dsub,
+                    "ncodes": ncodes,
+                }
+        return self._pq_cache[key]
+
     def _pallas_operands(self, precision: str) -> tuple:
         """The operand tail of the pallas certified program after
         ``(queries, db)`` — ONE home shared by :meth:`_certify_pallas`
         and bench.py's phase breakdown so neither can call the program
-        with the wrong arity: int8 passes the quantized placement; the
-        f32 precisions pass the scalar db-norm bound."""
-        if precision == "int8":
-            pl8 = self._int8_placement()
-            return (pl8["values"], pl8["scales"], pl8["norms"],
-                    pl8["consts"])
+        with the wrong arity: int8/int4 pass the quantized placement
+        (packed values for int4); pq passes (codes, codebooks, consts);
+        the f32 precisions pass the scalar db-norm bound."""
+        if precision in ("int8", "int4"):
+            pl = (self._int8_placement() if precision == "int8"
+                  else self._int4_placement())
+            return (pl["values"], pl["scales"], pl["norms"],
+                    pl["consts"])
+        if precision == "pq":
+            plq = self._pq_placement()
+            return (plq["codes"], plq["books"], plq["consts"])
         return (np.float32(self._db_norm_max()),)
 
     def search_certified(
@@ -1573,10 +1685,11 @@ class ShardedKNN:
                 f"model; use one of {CERTIFIED_PRECISIONS}"
             )
         quant_offset = 0.0
-        if precision == "int8":
+        if precision in ("int8", "int4"):
             # builds (and caches) the quantized placement: the program
             # needs the translation-invariance shift as a static constant
-            quant_offset = self._int8_placement()["offset"]
+            quant_offset = (self._int8_placement() if precision == "int8"
+                            else self._int4_placement())["offset"]
 
         eff_bin = bin_w or BIN_W
         shard_rows = self._shard_rows()
@@ -1687,18 +1800,25 @@ class ShardedKNN:
         # tail is precision-shaped (int8: the quantized placement; f32:
         # the scalar norm bound) — ONE home, _pallas_operands
         ops_tail = self._pallas_operands(precision)
-        if precision == "int8" and obs.enabled():
+        if precision in ("int8", "int4", "pq") and obs.enabled():
             # the per-query certified quantization bound ε — the quality
             # signal the device certificate computes and discards
-            # (quantize.score_error_bound_device): recomputed host-side
-            # (O(Q·D), noise next to the O(Q·N·D) sweep) and recorded as
-            # a distribution so a scraper sees how tight the int8 bound
-            # ran, not just the bench's one max
-            from knn_tpu.ops.quantize import score_error_bound
+            # (quantize.score_error_bound_device / pq's twin):
+            # recomputed host-side (O(Q·D), noise next to the O(Q·N·D)
+            # sweep) and recorded as a distribution so a scraper sees
+            # how tight the bound ran, not just the bench's one max
+            if precision == "pq":
+                from knn_tpu.ops.pq import score_error_bound_pq
 
-            pl8 = self._int8_placement()
-            eps = score_error_bound(q_np, pl8["stats"],
-                                    offset=pl8["offset"])
+                eps = score_error_bound_pq(
+                    q_np, self._pq_placement()["stats"])
+            else:
+                from knn_tpu.ops.quantize import score_error_bound
+
+                pl = (self._int8_placement() if precision == "int8"
+                      else self._int4_placement())
+                eps = score_error_bound(q_np, pl["stats"],
+                                        offset=pl["offset"])
             obs.histogram(_mn.CERTIFIED_QUANT_BOUND).observe_many(eps)
         bad_mask = np.zeros(q_np.shape[0], dtype=bool)
         n_corrected = 0
@@ -1983,16 +2103,18 @@ def _pallas_certified_program(
     eff_bin = bin_w or BIN_W
     eff_bq = block_q or BLOCK_Q
     w = _analysis_window(k, m)
-    int8 = precision == "int8"
 
     def spmd(q, t, *tail):
-        db_int8, consts, db_norm_max = _split_operand_tail(int8, tail)
+        db_q, db_pq, consts, db_norm_max = _split_operand_tail(
+            precision, tail)
         d32, li, lb = local_certified_candidates(
             q, t, m, tile_n=eff_tile, bin_w=eff_bin, survivors=survivors,
             block_q=eff_bq, final_select=final_select, precision=precision,
             binning=binning, final_recall_target=final_recall_target,
             grid_order=grid_order, kernel=kernel,
-            db_int8=db_int8, offset=quant_offset,
+            db_int8=db_q if precision == "int8" else None,
+            db_int4=db_q if precision == "int4" else None,
+            db_pq=db_pq, offset=quant_offset,
         )
         return _certify_pack_spmd(
             q, t, d32, li, lb, consts=consts, db_norm_max=db_norm_max,
@@ -2000,42 +2122,55 @@ def _pallas_certified_program(
             merge=merge, n_train=n_train, hosts=hosts, chips=chips,
             dcn_merge=dcn_merge,
             include_distances=include_distances,
+            pq_dsub=None if db_pq is None else int(db_pq[1].shape[2]),
         )
 
     return jax.jit(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(db_axes(mesh)), *_tail_specs(int8, mesh)),
+            in_specs=(P(QUERY_AXIS), P(db_axes(mesh)),
+                      *_tail_specs(precision, mesh)),
             out_specs=P(QUERY_AXIS),
             check_vma=False,
         )
     )
 
 
-def _tail_specs(int8: bool, mesh: Mesh):
+def _tail_specs(precision: str, mesh: Mesh):
     """shard_map in_specs of the precision-shaped operand tail
-    (ShardedKNN._pallas_operands): int8 = the quantized placement
-    (db-sharded values/scales/norms + replicated bound consts), f32 =
-    the replicated scalar db-norm bound."""
+    (ShardedKNN._pallas_operands): int8/int4 = the quantized placement
+    (db-sharded values/scales/norms + replicated bound consts), pq =
+    db-sharded codes + replicated codebooks + replicated per-subspace
+    bound consts, f32 = the replicated scalar db-norm bound."""
     dbp = db_axes(mesh)
-    return (P(dbp), P(dbp), P(dbp), P()) if int8 else (P(),)
+    if precision in ("int8", "int4"):
+        return (P(dbp), P(dbp), P(dbp), P())
+    if precision == "pq":
+        return (P(dbp), P(), P())
+    return (P(),)
 
 
-def _split_operand_tail(int8: bool, tail):
-    """(db_int8, consts, db_norm_max) from the operand tail — the
-    per-precision unpacking every pallas-certified program shares."""
-    if int8:
+def _split_operand_tail(precision: str, tail):
+    """(db_quant, db_pq, consts, db_norm_max) from the operand tail —
+    the per-precision unpacking every pallas-certified program shares.
+    ``db_quant`` is the (values, scales, norms) triple of the int8 OR
+    int4 arm (packed bytes for int4 — the kernel keyword decides which
+    contract it rides); ``db_pq`` is (codes, codebooks)."""
+    if precision in ("int8", "int4"):
         tq, ts, tnr, consts = tail
-        return (tq, ts, tnr), consts, None
+        return (tq, ts, tnr), None, consts, None
+    if precision == "pq":
+        codes, books, consts = tail
+        return None, (codes, books), consts, None
     (db_norm_max,) = tail
-    return None, None, db_norm_max
+    return None, None, None, db_norm_max
 
 
 def _certify_pack_spmd(q, t, d32, li, lb, *, consts, db_norm_max,
                        precision, quant_offset, m, k, w, merge, n_train,
                        hosts, chips, include_distances,
-                       dcn_merge=None):
+                       dcn_merge=None, pq_dsub=None):
     """The certify/pack tail of the pallas certified program, from one
     shard's ranked candidates ``(d32, li, lb)`` to the packed host-facing
     int32 array — ONE home shared by the one-shot program
@@ -2046,7 +2181,6 @@ def _certify_pack_spmd(q, t, d32, li, lb, *, consts, db_norm_max,
     either program."""
     from knn_tpu.ops.pallas_knn import RANK_SLACK
 
-    int8 = precision == "int8"
     db_shards = hosts * chips
     db_idx = _db_shard_index(hosts, chips)
     gi = jnp.where(li == _INT_SENTINEL, _INT_SENTINEL,
@@ -2088,15 +2222,22 @@ def _certify_pack_spmd(q, t, d32, li, lb, *, consts, db_norm_max,
     # the extra f32 reduction this on-device path adds (q_norm +
     # s_k arithmetic, <= ~12 eps of the norm scale): "highest" budgets
     # 32 eps total; bf16x3's 2^-14 dwarfs the f32 terms either way.
-    # int8's tolerance is the per-query PROVABLE quantization bound ε
-    # from the ACTUAL residual norms — byte-exact data (bvecs) gets
-    # an ε of pure f32 slack, tighter than bf16x3's.
+    # int8/int4 tolerances are the per-query PROVABLE quantization
+    # bound ε from the ACTUAL residual norms — byte-exact data (bvecs)
+    # gets an ε of pure f32 slack, tighter than bf16x3's; pq's is the
+    # per-subspace Cauchy-Schwarz bound (ops.pq, same actual-residual
+    # discipline hoisted per subspace at encode time).
     q32 = q.astype(jnp.float32)
-    if int8:
+    if precision in ("int8", "int4"):
         from knn_tpu.ops.quantize import score_error_bound_device
 
         q_norm, tol = score_error_bound_device(
             q32 - quant_offset, consts)
+    elif precision == "pq":
+        from knn_tpu.ops.pq import score_error_bound_pq_device
+
+        q_norm, tol = score_error_bound_pq_device(
+            q32, consts, dsub=pq_dsub)
     elif precision in ("bf16x3", "bf16x3f"):
         q_norm = jnp.sum(q32 * q32, axis=-1)
         tol = 2.0 ** -14 * (q_norm + db_norm_max)
@@ -2145,16 +2286,18 @@ def _pallas_coarse_program(
         local_coarse_candidates,
     )
 
-    int8 = precision == "int8"
     dbp = db_axes(mesh)
 
     def spmd(q, t, *tail):
-        db_int8, _, _ = _split_operand_tail(int8, tail)
+        db_q, db_pq, _, _ = _split_operand_tail(precision, tail)
         return local_coarse_candidates(
             q, t, m, tile_n=tile_n or TILE_N, bin_w=bin_w or BIN_W,
             survivors=survivors, block_q=block_q or BLOCK_Q,
             precision=precision, binning=binning,
-            grid_order=grid_order, kernel=kernel, db_int8=db_int8,
+            grid_order=grid_order, kernel=kernel,
+            db_int8=db_q if precision == "int8" else None,
+            db_int4=db_q if precision == "int4" else None,
+            db_pq=db_pq,
             offset=quant_offset, final_select=final_select,
         )
 
@@ -2162,7 +2305,7 @@ def _pallas_coarse_program(
         shard_map_compat(
             spmd,
             mesh=mesh,
-            in_specs=(P(QUERY_AXIS), P(dbp), *_tail_specs(int8, mesh)),
+            in_specs=(P(QUERY_AXIS), P(dbp), *_tail_specs(precision, mesh)),
             out_specs=(P(QUERY_AXIS, dbp), P(QUERY_AXIS, dbp),
                        P(QUERY_AXIS, dbp)),
             check_vma=False,
@@ -2191,10 +2334,10 @@ def _pallas_tail_program(
     hosts, chips = db_topology(mesh)
     dbp = db_axes(mesh)
     w = _analysis_window(k, m)
-    int8 = precision == "int8"
 
     def spmd(q, t, cd, ci, bounds, *tail):
-        _, consts, db_norm_max = _split_operand_tail(int8, tail)
+        _, db_pq, consts, db_norm_max = _split_operand_tail(
+            precision, tail)
         d32, li, lb = local_select_rescore(
             q, t, cd, ci, bounds, m, final_select=final_select,
             final_recall_target=final_recall_target,
@@ -2205,6 +2348,7 @@ def _pallas_tail_program(
             merge=merge, n_train=n_train, hosts=hosts, chips=chips,
             dcn_merge=dcn_merge,
             include_distances=include_distances,
+            pq_dsub=None if db_pq is None else int(db_pq[1].shape[2]),
         )
 
     return jax.jit(
@@ -2213,7 +2357,7 @@ def _pallas_tail_program(
             mesh=mesh,
             in_specs=(P(QUERY_AXIS), P(dbp), P(QUERY_AXIS, dbp),
                       P(QUERY_AXIS, dbp), P(QUERY_AXIS, dbp),
-                      *_tail_specs(int8, mesh)),
+                      *_tail_specs(precision, mesh)),
             out_specs=P(QUERY_AXIS),
             check_vma=False,
         ),

@@ -261,6 +261,9 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
                 knobs["final_select"] == "approx"
                 or knobs["binning"] != "grouped"):
             return  # the early-out's bitwise contract is exact+grouped
+        if knobs["precision"] == "pq" and knobs["kernel"] == "fused":
+            return  # ops.pallas_knn refuses: carry soundness unproven
+            # for reconstruction-space scores
         if (knobs["precision"] == "bf16x3f"
                 and knobs["kernel"] in ("streaming", "fused")
                 and (knobs["tile_n"] or 0) >= 32768
@@ -289,9 +292,18 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
     add(block_q=128)  # the pre-r05 default, kept as the A/B deviation
     add(tile_n=32768)  # the r5-projected winner cross (bq256 is default)
     add(tile_n=32768, final_select="approx")
-    for prec in ("bf16x3f", "highest", "int8"):
+    for prec in ("bf16x3f", "highest", "int8", "int4"):
         add(precision=prec)
     add(precision="int8", kernel="streaming")  # the HBM-bound cross
+    # the sub-int8 byte arms (PR 17): int4 x streaming is the headline
+    # hbm_bound attack (half the int8 db stream at the same MXU rate);
+    # pq streams ceil(d/dsub) code bytes — its candidates ride the SAME
+    # bitwise end-result gate (the certified fallback repairs every
+    # reconstruction-space miss), so an arm whose repaired answer
+    # drifts from the reference is ineligible, never a silent winner
+    add(precision="int4", kernel="streaming")
+    add(precision="pq", kernel="streaming")
+    add(precision="pq")
     # the vpu_select_bound attack the fused arm exists for, plus its
     # larger-tile r05-proven cross
     add(precision="int8", kernel="fused")
@@ -303,7 +315,8 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
     # now that the tuning default is 256
     for tile, bq, order, prec, kern in itertools.product(
             (None, 8192, 32768), (256, 128),
-            ("query_major", "db_major"), ("bf16x3", "bf16x3f", "int8"),
+            ("query_major", "db_major"),
+            ("bf16x3", "bf16x3f", "int8", "int4"),
             ("tiled", "streaming", "fused")):
         add(tile_n=tile, block_q=bq, grid_order=order, precision=prec,
             kernel=kern)
@@ -400,20 +413,61 @@ def _quantized_db(db):
     from knn_tpu.ops import quantize as qz
 
     qr = qz.quantize_rows_np(np.asarray(db, np.float32))
-    tn = np.empty(qr.values.shape[0], np.float32)
+    return (jnp.asarray(qr.values), jnp.asarray(qr.scales),
+            jnp.asarray(_row_norms(db)))
+
+
+def _row_norms(db) -> np.ndarray:
+    tn = np.empty(np.asarray(db).shape[0], np.float32)
     for lo in range(0, tn.shape[0], 65536):
         hs = np.asarray(db[lo : lo + 65536], np.float64)
         tn[lo : lo + hs.shape[0]] = (hs ** 2).sum(-1)
-    return (jnp.asarray(qr.values), jnp.asarray(qr.scales),
-            jnp.asarray(tn))
+    return tn
 
 
-def _timed_program(m: int, knobs: Dict[str, object], db_int8=None):
+def _quantized_db_int4(db):
+    """int4 twin of :func:`_quantized_db`: nibble-packed rows + scales
+    + norms, built ONCE per autotune() — same no-per-candidate-charge
+    discipline (production quantizes at placement time,
+    ShardedKNN._int4_placement)."""
+    import jax.numpy as jnp
+
+    from knn_tpu.ops import quantize as qz
+    from knn_tpu.ops.pallas_knn import DIM_CHUNK
+
+    host = np.asarray(db, np.float32)
+    qr = qz.quantize_rows_int4_np(host)
+    vals = qr.values
+    dpad = -(-vals.shape[1] // DIM_CHUNK) * DIM_CHUNK - vals.shape[1]
+    if dpad:
+        vals = np.pad(vals, ((0, 0), (0, dpad)))
+    return (jnp.asarray(qz.pack_nibbles(vals)), jnp.asarray(qr.scales),
+            jnp.asarray(_row_norms(host)))
+
+
+def _pq_db(db):
+    """Shared PQ placement for the pq candidates: train the per-subspace
+    codebooks ONCE (deterministic seeded k-means on a 1x1 mesh — the
+    codebooks are mesh-independent by construction) and hand the kernel
+    its (codes, codebooks) operands."""
+    import jax.numpy as jnp
+
+    from knn_tpu.ops import pq as pqm
+    from knn_tpu.parallel.mesh import make_mesh
+
+    res = pqm.train_pq(np.asarray(db, np.float32), mesh=make_mesh(1, 1))
+    return (jnp.asarray(res.codes), jnp.asarray(res.codebooks))
+
+
+def _timed_program(m: int, knobs: Dict[str, object], db_int8=None,
+                   db_int4=None, db_pq=None):
     """The device hot path one candidate is timed on —
     ``local_certified_candidates`` (kernel + final select + rescore);
     it is itself jitted with static knob arguments, so repeated timing
-    calls hit the jit cache.  ``db_int8`` is the shared pre-quantized
-    placement for int8 candidates (:func:`_quantized_db`)."""
+    calls hit the jit cache.  ``db_int8``/``db_int4``/``db_pq`` are the
+    shared pre-quantized placements for the quantized candidates
+    (:func:`_quantized_db` and twins) — only the one matching the
+    candidate's precision is threaded through."""
     from knn_tpu.ops.pallas_knn import (
         BIN_W,
         BLOCK_Q,
@@ -423,6 +477,10 @@ def _timed_program(m: int, knobs: Dict[str, object], db_int8=None):
 
     if knobs["precision"] != "int8":
         db_int8 = None
+    if knobs["precision"] != "int4":
+        db_int4 = None
+    if knobs["precision"] != "pq":
+        db_pq = None
 
     def run(q, t):
         return local_certified_candidates(
@@ -438,6 +496,8 @@ def _timed_program(m: int, knobs: Dict[str, object], db_int8=None):
             grid_order=knobs["grid_order"],
             kernel=knobs["kernel"],
             db_int8=db_int8,
+            db_int4=db_int4,
+            db_pq=db_pq,
         )
 
     return run
@@ -574,9 +634,11 @@ def autotune(
 
     m = min(k + margin, n - 1)
     qj, tj = np.asarray(queries), np.asarray(db)
-    # the int8 candidates' quantized db, built lazily ONCE and shared —
-    # it depends only on the db, never on the knobs
+    # the quantized candidates' placements, built lazily ONCE each and
+    # shared — they depend only on the db, never on the knobs
     shared_int8 = None
+    shared_int4 = None
+    shared_pq = None
     timings: Dict[str, Optional[float]] = {}
     errors: Dict[str, str] = {}
     rooflines: Dict[str, dict] = {}
@@ -679,7 +741,12 @@ def autotune(
                     continue
             if knobs["precision"] == "int8" and shared_int8 is None:
                 shared_int8 = _quantized_db(db)
-            prog = _timed_program(m, knobs, db_int8=shared_int8)
+            if knobs["precision"] == "int4" and shared_int4 is None:
+                shared_int4 = _quantized_db_int4(db)
+            if knobs["precision"] == "pq" and shared_pq is None:
+                shared_pq = _pq_db(db)
+            prog = _timed_program(m, knobs, db_int8=shared_int8,
+                                  db_int4=shared_int4, db_pq=shared_pq)
             out = prog(qj, tj)
             jax.block_until_ready(out)  # warm: compile outside the clock
             reps = []
@@ -726,7 +793,8 @@ def autotune(
 
     if _profiler.profile_dir():
         try:
-            prog = _timed_program(m, best_knobs, db_int8=shared_int8)
+            prog = _timed_program(m, best_knobs, db_int8=shared_int8,
+                                  db_int4=shared_int4, db_pq=shared_pq)
             with _profiler.device_trace(f"tune_{key}") as tdir:
                 jax.block_until_ready(prog(qj, tj))
             trace_dir = tdir

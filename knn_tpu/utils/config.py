@@ -25,7 +25,8 @@ BACKENDS = ("jax", "native")
 #: home (jax-free, so the CLI can build its --help without importing
 #: JAX); cli.py's choices, this module's validation, and
 #: parallel.sharded's _pallas_setup check all consume it.
-CERTIFIED_PRECISIONS = ("bf16x3", "bf16x3f", "highest", "int8")
+CERTIFIED_PRECISIONS = ("bf16x3", "bf16x3f", "highest", "int8", "int4",
+                        "pq")
 
 
 @dataclass
@@ -91,9 +92,10 @@ class JobConfig:
     tune_cache: Optional[str] = None
     #: explicit kernel matmul precision for the certified pallas
     #: selector (ops.pallas_knn.PRECISIONS minus the uncertifiable
-    #: "default"): "bf16x3" | "bf16x3f" | "highest" | "int8" (the
-    #: quantized MXU arm — ops.quantize).  None = resolve through the
-    #: autotuner cache / library default; an explicit value beats both.
+    #: "default"): "bf16x3" | "bf16x3f" | "highest" | "int8" | "int4"
+    #: (the quantized MXU arms — ops.quantize) | "pq" (product-quantized
+    #: codes — ops.pq).  None = resolve through the autotuner cache /
+    #: library default; an explicit value beats both.
     pallas_precision: Optional[str] = None
     # --- native backend knobs ---
     num_threads: int = 0  # 0 = hardware concurrency

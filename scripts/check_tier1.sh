@@ -42,7 +42,8 @@ if [ "${1:-}" = "--fast" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_pallas_knn.py tests/test_pallas_streaming.py \
     tests/test_fused_overlap.py \
-    tests/test_quantize.py tests/test_tuning.py tests/test_obs.py \
+    tests/test_quantize.py tests/test_pq.py tests/test_tuning.py \
+    tests/test_obs.py \
     tests/test_slo.py tests/test_sentinel.py tests/test_roofline.py \
     tests/test_calibrate.py \
     tests/test_loadgen.py tests/test_admission.py \

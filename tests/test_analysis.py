@@ -592,6 +592,25 @@ def test_vmem_operand_widths_mirror_roofline():
     assert vmem.AUX_ROWS_DEFAULT == roofline.AUX_ROWS_DEFAULT
 
 
+def test_operand_width_tables_are_the_shared_widths_objects():
+    """Identity pin: every consumer re-exports the ONE width table in
+    knn_tpu.analysis.widths — the SAME objects, not copies.  An `is`
+    here (vs `==`) rules out the drift mode where a consumer forks its
+    table, passes today's equality, and then diverges on the next new
+    precision arm."""
+    from knn_tpu.analysis import hbm, widths
+    from knn_tpu.obs import roofline
+
+    assert roofline.DB_ELEM_BYTES is widths.DB_ELEM_BYTES
+    assert roofline.AUX_ROWS is widths.AUX_ROWS
+    assert roofline.QUERY_ELEM_BYTES is widths.QUERY_ELEM_BYTES
+    assert vmem.DB_PARTS is widths.DB_PARTS
+    assert vmem.AUX_ROWS is widths.AUX_ROWS
+    assert vmem.DIM_CHUNK == widths.DIM_CHUNK == roofline.DIM_CHUNK
+    # ints are compared by value (an int re-export has no alias risk)
+    assert hbm.AUX_BYTES_PER_ROW == widths.AUX_BYTES_PER_ROW
+
+
 def test_launch_estimate_breakdown_and_monotonicity():
     shape = dict(vmem.HEADLINE_SHAPE)
     est = vmem.launch_estimate(**shape)

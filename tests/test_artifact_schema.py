@@ -182,7 +182,8 @@ def test_version_tokens_resolve_and_are_owned_once():
                       "campaign_version": "campaign",
                       "version": "loadgen_knee",
                       "mutation_version": "mutation",
-                      "ivf_version": "ivf"}
+                      "ivf_version": "ivf",
+                      "pq_version": "pq"}
 
 
 def test_catalog_refuses_duplicate_version_tokens():
@@ -219,6 +220,7 @@ def test_sentinel_curated_fields_derived_in_legacy_order():
         ("mutation_admitted_p99_ms", "lower"),
         ("recall_at_k", "higher"),
         ("ivf_qps", "higher"),
+        ("bytes_streamed_ratio", "lower"),
     )
 
 

@@ -325,9 +325,10 @@ def test_roofline_v2_select_overlap_semantics():
     # v3 = the calibrated model (tests/test_calibrate.py owns the
     # overlay semantics); v4 = the multi-host DCN merge term
     # (tests/test_multihost.py/test_roofline.py own it); v5 = the IVF
-    # probed-bytes term (tests/test_ivf.py owns it); the select-overlap
-    # formulas above are pinned version-independently
-    assert roofline.MODEL_VERSION == 5
+    # probed-bytes term (tests/test_ivf.py owns it); v6 = the sub-int8
+    # compressed-tier widths (tests/test_roofline.py owns it); the
+    # select-overlap formulas above are pinned version-independently
+    assert roofline.MODEL_VERSION == 6
     # a fused config whose carry would exceed MAX_CARRY_DEPTH disarms
     # in the kernel — the model mirrors the disarm and falls back to
     # the serialized ceiling, so pruning/--best can never hold other

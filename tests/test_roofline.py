@@ -57,6 +57,26 @@ def _actual_operand_nbytes(db, precision):
             jnp.broadcast_to(tn[None, :], (8, n)),
             jnp.broadcast_to(ts[None, :].astype(jnp.float32), (8, n)),
         ], axis=0).nbytes
+    elif precision == "int4":
+        from knn_tpu.ops.quantize import pack_nibbles_t, quantize_rows_int4
+
+        tq, ts = quantize_rows_int4(db)
+        values = pack_nibbles_t(tq).nbytes
+        # norms row 0, scales row 1, zero fill rows 2-7: the ONE 8-row
+        # aux block (kernel reads one row of each; no broadcast)
+        aux = jnp.concatenate([
+            jnp.sum(db * db, axis=-1)[None, :],
+            ts[None, :].astype(jnp.float32),
+            jnp.zeros((6, n), jnp.float32),
+        ], axis=0).nbytes
+    elif precision == "pq":
+        # the streamed operand is the [N, ceil(d/dsub)] uint8 code
+        # array (shape-determined — training moves no extra bytes)
+        # plus the 8-row pad-fill carrier
+        m_sub = -(-db.shape[1] // 4)
+        values = jnp.zeros((n, m_sub), jnp.uint8).nbytes
+        aux = jnp.broadcast_to(
+            jnp.zeros((n,), jnp.float32)[None, :], (8, n)).nbytes
     else:  # highest / default stream the raw f32 rows
         values = db.astype(jnp.float32).nbytes
         aux = jnp.broadcast_to(
@@ -65,7 +85,8 @@ def _actual_operand_nbytes(db, precision):
 
 
 @pytest.mark.parametrize("precision",
-                         ["bf16x3", "bf16x3f", "int8", "highest"])
+                         ["bf16x3", "bf16x3f", "int8", "int4", "pq",
+                          "highest"])
 @pytest.mark.parametrize("kernel", ["tiled", "streaming"])
 def test_db_byte_terms_match_actual_operand_nbytes(rng, precision, kernel):
     """Property: the model's per-pass db byte terms equal the nbytes of
@@ -110,6 +131,61 @@ def test_bench_peak_table_is_a_view_over_roofline():
 
     assert bench._PEAK_BY_KIND == roofline.bf16_peak_by_kind()
     assert bench._PEAK_BY_KIND["TPU v5 lite"] == 197e12
+
+
+# --- MODEL_VERSION 6: the sub-int8 compressed tiers ---------------------
+
+
+def test_sub_int8_row_bytes_pinned():
+    """Pinned byte ratios at SIFT dims (docs/PERF.md precision
+    ladder): int4 streams HALF int8's row (an eighth of f32), pq at
+    the default dsub=4 streams m = ceil(d/4) code bytes — m/(4d) of
+    the f32 row, 1/16 at d=128."""
+    from knn_tpu.analysis import widths
+
+    f32 = widths.db_row_bytes(128, "highest")
+    i8 = widths.db_row_bytes(128, "int8")
+    i4 = widths.db_row_bytes(128, "int4")
+    pq = widths.db_row_bytes(128, "pq", dsub=4)
+    assert (f32, i8, i4, pq) == (512, 128, 64, 32)
+    assert i4 / i8 == 0.5 and i4 / f32 == 0.125
+    assert pq / f32 == widths.pq_nsub(128, 4) / (4 * 128) == 1 / 16
+    # int4's packed aux (norms row 0 + scales row 1 in ONE 8-row
+    # block) also halves int8's 16-row broadcast block
+    a = roofline.db_operand_nbytes(1000, 128, "int4")
+    b = roofline.db_operand_nbytes(1000, 128, "int8")
+    assert 2 * a["db_aux"] == b["db_aux"]
+
+
+def test_int4_streaming_breaks_the_int8_hbm_ceiling():
+    """THE acceptance pin of the compressed-tier ISSUE: at the
+    hbm-bound operating point (small nq, block_q=8, SIFT1M on a v5e)
+    both int8 and int4 streaming hit the HBM wall, and halving the
+    streamed bytes lifts the modeled ceiling >= 1.8x."""
+    assert roofline.MODEL_VERSION == 6
+    kw = dict(n=1_000_000, d=128, k=10, nq=8, kernel="streaming",
+              block_q=8, device_kind="TPU v5e", backend="tpu")
+    m8 = roofline.pallas_cost_model(precision="int8", **kw)
+    m4 = roofline.pallas_cost_model(precision="int4", **kw)
+    assert m8["bound_class"] == "hbm_bound"
+    assert m4["bound_class"] == "hbm_bound"
+    assert m4["ceiling_qps"] >= 1.8 * m8["ceiling_qps"]
+    assert roofline.validate_block(m4) == []
+
+
+def test_pq_model_prices_lut_width_and_composes_with_probes():
+    """pq's full-db stream is NOT a free lunch: the one-hot LUT
+    contraction prices at m*ncodes MXU width, so the full stream is
+    mxu_bound; composed with IVF probing (MODEL_VERSION 5 knobs) the
+    byte and flop reductions multiply and the ceiling climbs."""
+    base = dict(n=1_000_000, d=128, k=10, nq=8, kernel="streaming",
+                block_q=8, device_kind="TPU v5e", backend="tpu")
+    full = roofline.pallas_cost_model(precision="pq", **base)
+    assert full["bound_class"] == "mxu_bound"
+    probed = roofline.pallas_cost_model(precision="pq", nprobe=32,
+                                        ncentroids=1024, **base)
+    assert probed["ceiling_qps"] > full["ceiling_qps"]
+    assert roofline.validate_block(probed) == []
 
 
 # --- ceilings bound measured reality -----------------------------------
@@ -242,6 +318,15 @@ def test_cache_key_carries_roofline_token_and_pre_roofline_misses(
     knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
     assert info["source"] == "default"
     assert knobs == tuning.DEFAULT_KNOBS
+    # a STALE-token entry (the MODEL_VERSION 5 key, before the
+    # compressed-tier arms re-priced the grid) must miss the same way:
+    # the version bump self-invalidates every pre-6 winner
+    stale = key.replace(f"|rl{roofline.MODEL_VERSION}|", "|rl5|")
+    assert stale != key
+    cache.put(stale, {"knobs": {**tuning.DEFAULT_KNOBS,
+                                "kernel": "streaming"}})
+    knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "default"
     # a current entry carrying the winner's attribution DOES hit, and
     # the verdict rides the resolve info + the /statusz store
     block = roofline.attribute(

@@ -220,6 +220,15 @@ def test_stale_kernel_version_entry_falls_back_to_defaults(cache_path):
                                "kernel": "streaming"}})
     cache.put(base + "|kv-stale", {"knobs": {**tuning.DEFAULT_KNOBS,
                                              "tile_n": 256}})
+    # ... and a KERNEL_VERSION-4 entry carrying a sub-int8 winner: the
+    # 4 -> 5 bump (the int4/pq arms changed the kernel) must invalidate
+    # it even though "precision": "int4" is a perfectly current knob
+    from knn_tpu.ops.pallas_knn import KERNEL_VERSION
+
+    assert KERNEL_VERSION == 5
+    cache.put(base + "|kv4", {"knobs": {**tuning.DEFAULT_KNOBS,
+                                        "precision": "int4",
+                                        "kernel": "streaming"}})
     knobs, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
     assert info["source"] == "default"
     assert knobs == tuning.DEFAULT_KNOBS
@@ -238,6 +247,31 @@ def test_standard_grid_includes_int8_candidate():
     # full covers int8 x streaming (the HBM-bound cross)
     assert any(c["precision"] == "int8" and c["kernel"] == "streaming"
                for c in tuning.knob_grid("full"))
+
+
+def test_grid_covers_sub_int8_arms_and_refuses_pq_fused():
+    """The compressed tiers enter the grid where the roofline says
+    they pay: int4 x streaming (the headline hbm_bound attack) and
+    both pq db-streaming strategies sit in standard; full adds the
+    int4 x fused cross.  pq x fused appears at NO level — the kernel
+    refuses it (carry soundness unproven for reconstruction-space
+    scores), so a grid that emitted it would crash the tuner."""
+    std = tuning.knob_grid("standard")
+    assert any(c["precision"] == "int4" and c["kernel"] == "streaming"
+               for c in std)
+    assert any(c["precision"] == "pq" and c["kernel"] == "streaming"
+               for c in std)
+    assert any(c["precision"] == "pq" and c["kernel"] == "tiled"
+               for c in std)
+    full = tuning.knob_grid("full")
+    assert any(c["precision"] == "int4" and c["kernel"] == "fused"
+               for c in full)
+    for level in ("quick", "standard", "full"):
+        assert all(not (c["precision"] == "pq" and c["kernel"] == "fused")
+                   for c in tuning.knob_grid(level)), level
+    # quick stays sub-int8-free (CPU-interpret friendly minimal set)
+    assert all(c["precision"] not in ("int4", "pq")
+               for c in tuning.knob_grid("quick"))
 
 
 def test_gated_out_int8_candidate_can_never_win(data, cache_path,
