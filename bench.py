@@ -41,7 +41,10 @@ Env overrides:
   KNN_BENCH_CONFIG   sift1m (default) | glove | gist1m   (BASELINE configs 3/4/5)
   KNN_BENCH_MODES    comma list from {exact,certified_approx,
                      certified_pallas,serving,knee,multihost,mutation,
-                     ivf}
+                     ivf,join}; ``join`` is the opt-in bulk kNN-join
+                     line (knn_tpu.join: double-buffered superblock
+                     stream vs looped serving on the same placement;
+                     KNN_BENCH_JOIN_ROWS/_SUPERBLOCK/_DEPTH shape it)
   KNN_BENCH_RUNS     timed repetitions per mode (default 5)
   KNN_BENCH_N, KNN_BENCH_DIM, KNN_BENCH_K, KNN_BENCH_NQ, KNN_BENCH_BATCH,
   KNN_BENCH_TILE, KNN_BENCH_CPU_QUERIES, KNN_BENCH_MARGIN,
@@ -208,6 +211,17 @@ try:
         "KNN_BENCH_MUTATION_SECONDS", "2.0"))
     MUTATION_WRITE_FRACTION = float(os.environ.get(
         "KNN_BENCH_MUTATION_WRITE_FRACTION", "0.15"))
+
+    #: ``join`` mode (knn_tpu.join): offline bulk kNN-join of a
+    #: host-resident query set against the placed corpus through the
+    #: double-buffered superblock stream, beside a looped-serving
+    #: baseline on the SAME placement — the amortization claim as one
+    #: line.  Opt-in via KNN_BENCH_MODES=..,join.  JOIN_ROWS=0 sizes
+    #: the query set from NQ/BATCH; JOIN_SUPERBLOCK=0 defers to the
+    #: engine's resolution ladder (KNN_TPU_JOIN_* applies there too).
+    JOIN_ROWS = _env_int("KNN_BENCH_JOIN_ROWS", 0)
+    JOIN_SUPERBLOCK = _env_int("KNN_BENCH_JOIN_SUPERBLOCK", 0)
+    JOIN_DEPTH = _env_int("KNN_BENCH_JOIN_DEPTH", 2)
 except Exception as _e:  # bad env: the one-JSON-line contract still holds
     print(json.dumps({
         "metric": "knn_qps_config", "value": None, "unit": "queries/s",
@@ -1184,6 +1198,102 @@ def main() -> None:
             "roofline": _rl.attribute(model, qps_h),
         }
 
+    def sweep_join():
+        """Opt-in bulk kNN-join measurement (knn_tpu.join): every row
+        of a host-resident query set A joined against the placed corpus
+        through the double-buffered superblock stream, then the SAME
+        rows pushed through a looped, per-block-synchronous serving
+        loop on the same placement — the amortization claim
+        (rows/s + overlap_ratio vs baseline_rows_per_s) as one
+        validated ``join`` artifact block.  rows_per_s hoists to the
+        line as ``join_rows_per_s`` via the schema catalog."""
+        from knn_tpu.join import knn_join
+        from knn_tpu.join.artifact import validate_join_block
+        from knn_tpu.obs import roofline as _rl
+
+        rows = JOIN_ROWS or max(NQ, 4 * BATCH)
+        reps = -(-rows // NQ)
+        qa = np.tile(queries, (reps, 1))[:rows] if reps > 1 \
+            else queries[:rows]
+        sb = JOIN_SUPERBLOCK or None
+        # warm run compiles the stream program (and fixes the resolved
+        # superblock for the baseline), then RUNS timed joins
+        d_j, i_j, jstats = knn_join(prog, qa, mode="stream",
+                                    superblock_rows=sb, depth=JOIN_DEPTH)
+        sb_rows = int(jstats["superblock_rows"])
+        walls, overlaps = [], []
+        for _ in range(RUNS):
+            _, _, jstats = knn_join(prog, qa, mode="stream",
+                                    superblock_rows=sb_rows,
+                                    depth=JOIN_DEPTH)
+            walls.append(jstats["wall_s"])
+            overlaps.append(jstats["overlap_ratio"])
+        wall = float(np.mean(walls))
+        rows_per_s = round(rows / wall, 2)
+
+        # looped-serving baseline: the same superblocks through
+        # prog.search, every block's result fetched before the next
+        # dispatch — the pre-join serving pattern (no dispatch-ahead,
+        # no donated buffers), so the delta IS the overlap machinery
+        def pad_to(chunk):
+            pad = sb_rows - chunk.shape[0]
+            return np.pad(chunk, ((0, pad), (0, 0))) if pad else chunk
+
+        np.asarray(prog.search(pad_to(qa[:sb_rows]))[0])  # warm, blocked
+        base_walls = []
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            for lo in range(0, rows, sb_rows):
+                d_b, _ = prog.search(pad_to(qa[lo:lo + sb_rows]))
+                np.asarray(d_b)  # block: serving fetches per request
+            base_walls.append(time.perf_counter() - t0)
+        baseline = round(rows / float(np.mean(base_walls)), 2)
+
+        block = {
+            "join_version": _join_version(),
+            "mode": jstats["mode"],
+            "rows": int(jstats["rows"]),
+            "k": int(jstats["k"]),
+            "superblock_rows": sb_rows,
+            "depth": int(jstats["depth"]),
+            "order": jstats["order"],
+            "superblocks": int(jstats["superblocks"]),
+            "db_segments": int(jstats["db_segments"]),
+            "dispatches": int(jstats["dispatches"]),
+            "rows_per_s": rows_per_s,
+            "overlap_ratio": overlaps[-1],
+            "wall_s": round(wall, 4),
+            "plan": jstats["plan"],
+            "baseline_rows_per_s": baseline,
+            "speedup_vs_serving": (round(rows_per_s / baseline, 3)
+                                   if baseline else None),
+        }
+        errs = validate_join_block(block)
+        if errs:
+            block["validation_errors"] = errs
+        entry = {"join": block}
+        try:
+            # the MODEL_VERSION-7 amortized-db-bytes model for this
+            # exact join shape: terms.h2d + the join sub-block, the
+            # analytic rows/s ceiling the measured rate is judged by
+            model = _rl.join_cost_model(
+                n_a=rows, n_b=N, d=DIM, k=K, superblock_rows=sb_rows,
+                selector="exact",
+                db_segment_rows=int(jstats["plan"].get(
+                    "db_segment_rows", 0)),
+                device_kind=getattr(dev, "device_kind", ""),
+                backend=backend,
+                num_devices=len(mesh.devices.ravel()))
+            entry["roofline"] = _rl.attribute(model, rows_per_s)
+        except Exception as e:  # noqa: BLE001 — advisory only
+            entry["roofline"] = {"error": f"{type(e).__name__}: {e}"}
+        return entry
+
+    def _join_version():
+        from knn_tpu.join.artifact import JOIN_VERSION
+
+        return JOIN_VERSION
+
     def roofline_for_mode(mode, entry):
         """The selector's ``roofline`` block (knn_tpu.obs.roofline):
         analytic ceiling q/s + bound class for the config this mode
@@ -1528,6 +1638,15 @@ def main() -> None:
                 entry = {"error": f"{type(e).__name__}: {e}"}
             results[mode] = entry
             continue
+        if mode == "join":
+            # bulk kNN-join throughput (rows/s, not q/s): an offline
+            # batch-shape line, never a headline-number competitor
+            try:
+                entry = sweep_join()
+            except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+                entry = {"error": f"{type(e).__name__}: {e}"}
+            results[mode] = entry
+            continue
         try:
             fn = sweeps[mode]
             _vlog(f"mode {mode}: recall check + warm ...")
@@ -1782,6 +1901,10 @@ def main() -> None:
             "multihost": results["multihost"]["multihost"],
             "multihost_qps": results["multihost"].get("qps_mean"),
         } if results.get("multihost", {}).get("multihost") else {}),
+        # the bulk kNN-join measurement (opt-in join mode): block on
+        # the line; rows_per_s hoists below as join_rows_per_s
+        **({"join": results["join"]["join"]}
+           if results.get("join", {}).get("join") else {}),
         **(gate or {}),
         "recall_at_k": results[best].get("recall_at_k"),
         **recall_flag,
@@ -1830,7 +1953,8 @@ def main() -> None:
     # line contributes its declared top-level keys — roofline_pct/
     # bound_class/roofline_estimated off the winning mode's roofline
     # block, model_residual_pct off an applied calibration overlay,
-    # knee_qps, mutation_admitted_p99_ms, hosttier_sweeps — so the
+    # knee_qps, mutation_admitted_p99_ms, hosttier_sweeps,
+    # join_rows_per_s — so the
     # sentinel's curated-field baselines and the artifact refresher
     # read them flat.  One loop instead of one stanza per block; a new
     # bench block hoists by declaring, not by editing this file.

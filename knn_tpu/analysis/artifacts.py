@@ -870,6 +870,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("mutation", "any"),
             Field("ivf", "any"),
             Field("pq", "any"),
+            Field("join", "any"),
             Field("multihost", "any"),
             Field("campaign", "any"),
             Field("sentinel", "any"),
@@ -883,6 +884,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("mutation_admitted_p99_ms", "number", nullable=True),
             Field("ivf_qps", "number", nullable=True),
             Field("bytes_streamed_ratio", "number", nullable=True),
+            Field("join_rows_per_s", "number", nullable=True),
             Field("multihost_hosts", "int", nullable=True),
             Field("multihost_merge", "str", nullable=True),
             Field("multihost_qps", "number", nullable=True),
@@ -1002,6 +1004,16 @@ CATALOG: Tuple[BlockSchema, ...] = (
                   choices=Ref(_XO, "STRATEGIES"),
                   legacy="terms.dcn.strategy {value!r} not in "
                          "{choices}"),
+            # the MODEL_VERSION-7 join h2d term: present only on join
+            # blocks (join_cost_model), and then it must be priced
+            Field("terms.h2d", "dict",
+                  legacy="terms.h2d is not a dict"),
+            Field("terms.h2d.time_s", "number", required=True, ge=0,
+                  legacy="terms.h2d.time_s missing or negative"),
+            Field("terms.h2d.bytes", "int", required=True, ge=0,
+                  legacy="terms.h2d.bytes missing or negative"),
+            # the join-shape annotations join_cost_model stamps
+            Field("join", "any"),
             # MODEL_VERSION 3 blocks carry an explicit calibration
             # verdict; pre-calibration history (v1/v2) legitimately
             # lacks it, but one that IS present must be well-formed
@@ -1428,6 +1440,82 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("error", "any"),
         ),
     ),
+    # --- bulk kNN-join ---------------------------------------------------
+    BlockSchema(
+        name="join",
+        block_path="join",
+        doc="docs/PERF.md#Bulk kNN-join (MODEL_VERSION 7)",
+        validator="knn_tpu.join.artifact:validate_join_block",
+        emitters=("bench.py",),
+        fingerprints=(frozenset({"join_version", "superblock_rows"}),),
+        version_field="join_version",
+        version_ref=Ref("knn_tpu.join.artifact", "JOIN_VERSION"),
+        version_exact=True,
+        not_dict_legacy="join block must be a dict, got {vtype}",
+        error_exempt="validator",
+        refusal_label="join",
+        curate=True,
+        sweep=True,
+        missing_order=("join_version", "mode", "rows", "k",
+                       "superblock_rows", "depth", "order",
+                       "superblocks", "db_segments", "dispatches",
+                       "rows_per_s", "overlap_ratio"),
+        missing_legacy="missing {key!r}",
+        hoists=(Hoist("rows_per_s", "join_rows_per_s"),),
+        # the join headline the sentinel baselines: offline rows/s,
+        # higher is better — the number the superblock amortization
+        # exists to raise
+        curated=(Curated("join_rows_per_s", "higher", 12),),
+        checks=(
+            Field("join_version", "version", required=True,
+                  legacy="join_version must be {version}, got "
+                         "{value!r}"),
+            Field("mode", required=True,
+                  choices=Ref("knn_tpu.join.engine", "JOIN_MODES"),
+                  legacy="mode {value!r} not in {choices}"),
+            Field("rows", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("k", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("superblock_rows", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("depth", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("order", required=True,
+                  choices=("query_major", "db_major"),
+                  legacy="order {value!r} not in {choices}"),
+            Field("superblocks", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("db_segments", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("dispatches", "int", required=True, ge=1,
+                  legacy="{path} must be a positive int, got "
+                         "{value!r}"),
+            Field("rows_per_s", "number", required=True, nullable=True,
+                  ge=0,
+                  legacy="rows_per_s must be a non-negative number or "
+                         "null, got {value!r}"),
+            # stream mode measures the dispatch-timeline overlap; the
+            # certified loop reports null (it has no pipeline)
+            Field("overlap_ratio", "number", required=True,
+                  nullable=True, ge=0, le=1,
+                  legacy="overlap_ratio must be a number in [0, 1] or "
+                         "null, got {value!r}"),
+            Field("baseline_rows_per_s", "any"),
+            Field("speedup_vs_serving", "any"),
+            Field("wall_s", "any"),
+            Field("plan", "any"),
+            Field("fallback_queries", "any"),
+            Field("validation_errors", "any"),
+            Field("error", "any"),
+        ),
+    ),
     # --- sentinel verdict ------------------------------------------------
     BlockSchema(
         name="sentinel",
@@ -1459,6 +1547,10 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("errors", "dict", nullable=True),
             Field("roofline_per_candidate", "dict", nullable=True),
             Field("gate", "str", required=True),
+            # which knob-grid regime timed the entry: "latency" (the
+            # serving default) or "throughput" (the bulk-join grid,
+            # cache-keyed with a |throughput suffix)
+            Field("profile", "str", nullable=True),
             Field("runs", "int", required=True, ge=1),
             Field("n_queries", "int", required=True, ge=1),
             Field("margin", "int", nullable=True),

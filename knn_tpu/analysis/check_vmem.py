@@ -97,9 +97,15 @@ def check_vmem(ctx: Context) -> List[Finding]:
         return []  # fixture tree without the tuning layer
     from knn_tpu.tuning.autotune import DEFAULT_KNOBS, _label, knob_grid
 
+    # invariant 2 sweeps BOTH tuning regimes: the throughput profile's
+    # block_q 512/1024 ladder (the bulk-join grid, knn_tpu.join) is
+    # exactly where a fits-nowhere arm is easiest to author by accident
     findings = grid_findings(
         knob_grid("full"), DEFAULT_KNOBS,
         label=lambda knobs: _label(knobs))
+    findings += grid_findings(
+        knob_grid("full", profile="throughput"), DEFAULT_KNOBS,
+        label=lambda knobs: "throughput:" + _label(knobs))
     # invariant 3: the runtime gate is wired (autotune prices before
     # timing) — a model nobody consults protects nothing
     src = ctx.read(autotune_rel)

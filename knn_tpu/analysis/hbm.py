@@ -91,10 +91,122 @@ def n_sweeps(n_rows: int, dim: int, budget_bytes: int, *,
                              hosts=hosts, shard_multiple=shard_multiple))
 
 
+def query_block_bytes(n_rows: int, dim: int, itemsize: int = 4) -> int:
+    """Host->device bytes one ``[n_rows, dim]`` QUERY block transfers —
+    no aux column (queries carry no placed row norms), otherwise the
+    :func:`placement_bytes` arithmetic."""
+    n_rows, dim = int(n_rows), int(dim)
+    if n_rows < 0 or dim <= 0:
+        raise ValueError(f"bad query block shape ({n_rows}, {dim})")
+    return n_rows * dim * int(itemsize)
+
+
+def superblock_rows_for_budget(budget_bytes: int, dim: int, *,
+                               itemsize: int = 4,
+                               query_multiple: int = 1) -> int:
+    """The largest query-superblock row count whose h2d block fits
+    ``budget_bytes``, rounded down to ``query_multiple`` (the query
+    shard count — a placed block must divide evenly across the query
+    axis).  The query-side mirror of :func:`rows_for_budget`."""
+    if budget_bytes <= 0:
+        raise ValueError(f"budget_bytes must be > 0, got {budget_bytes}")
+    rows = int(budget_bytes) // (int(dim) * int(itemsize))
+    return (rows // query_multiple) * query_multiple
+
+
+def plan_superblocks(
+    n_a: int, dim: int, budget_bytes: int, *, itemsize: int = 4,
+    query_multiple: int = 1,
+) -> List[Tuple[int, int]]:
+    """``[(lo, hi), ...]`` query-superblock extents covering
+    ``[0, n_a)`` — the join engine's query-side :func:`plan_segments`:
+    every superblock the SAME padded width (the ragged tail pads up, so
+    all blocks share one compiled program shape).  Raises when the
+    budget cannot hold even ``query_multiple`` query rows."""
+    n_a = int(n_a)
+    if n_a <= 0:
+        raise ValueError(f"n_a must be > 0, got {n_a}")
+    sb = superblock_rows_for_budget(budget_bytes, dim, itemsize=itemsize,
+                                    query_multiple=query_multiple)
+    if sb < query_multiple or sb < 1:
+        raise ValueError(
+            f"query budget {budget_bytes} B cannot hold even "
+            f"{query_multiple} query rows of dim {dim} at {itemsize} "
+            f"B/elem; raise the budget or use fewer query shards")
+    sb = min(sb, -(-n_a // query_multiple) * query_multiple)
+    return [(lo, min(lo + sb, n_a)) for lo in range(0, n_a, sb)]
+
+
+def n_superblocks(n_a: int, dim: int, budget_bytes: int, *,
+                  itemsize: int = 4, query_multiple: int = 1) -> int:
+    """Superblock count the plan implies — what tests pin the executed
+    join superblock counter against."""
+    return len(plan_superblocks(n_a, dim, budget_bytes, itemsize=itemsize,
+                                query_multiple=query_multiple))
+
+
+def plan_join(
+    n_a: int, n_b: int, dim: int, *, superblock_rows: int,
+    db_segment_rows: int = 0, itemsize: int = 4,
+) -> dict:
+    """The bulk kNN-join sweep-nesting plan: which loop goes OUTER when
+    both the query set A and the corpus B stream from host RAM.
+
+    With ``s = ceil(n_a / superblock_rows)`` superblocks and
+    ``g = ceil(n_b / db_segment_rows)`` db segments
+    (``db_segment_rows = 0`` means B is device-resident, ``g = 1`` and
+    its stream bytes are 0 — placed once at construction):
+
+    - **query_major** (superblocks outer): each superblock transfers
+      h2d once, each db segment re-streams once PER superblock —
+      ``h2d = A_bytes + s * B_bytes``.
+    - **db_major** (db segments outer): each db segment transfers h2d
+      once and serves every superblock while resident, each superblock
+      re-streams once per segment — ``h2d = B_bytes + g * A_bytes``.
+
+    The returned ``order`` minimizes total h2d bytes (ties prefer
+    query_major — it needs no per-superblock top-k carry).  A resident
+    B is always query_major.  Dispatch count is ``s * g`` either way;
+    only the transfer schedule differs."""
+    n_a, n_b = int(n_a), int(n_b)
+    sb = int(superblock_rows)
+    if n_a <= 0 or n_b <= 0 or sb <= 0:
+        raise ValueError(
+            f"bad join shape n_a={n_a} n_b={n_b} "
+            f"superblock_rows={superblock_rows}")
+    s = -(-n_a // sb)
+    a_bytes = query_block_bytes(n_a, dim, itemsize)
+    seg = int(db_segment_rows)
+    if seg <= 0:  # resident corpus: placed once, no per-sweep stream
+        g = 1
+        b_bytes = 0
+    else:
+        g = -(-n_b // seg)
+        b_bytes = placement_bytes(n_b, dim, itemsize)
+    qm_bytes = a_bytes + s * b_bytes
+    dm_bytes = b_bytes + g * a_bytes
+    order = "db_major" if (seg > 0 and dm_bytes < qm_bytes) \
+        else "query_major"
+    return {
+        "order": order,
+        "superblocks": s,
+        "db_segments": g,
+        "dispatches": s * g,
+        "h2d_bytes": {"query_major": qm_bytes, "db_major": dm_bytes},
+        "a_bytes": a_bytes,
+        "b_stream_bytes": b_bytes,
+    }
+
+
 __all__ = [
     "AUX_BYTES_PER_ROW",
     "placement_bytes",
     "rows_for_budget",
     "plan_segments",
     "n_sweeps",
+    "query_block_bytes",
+    "superblock_rows_for_budget",
+    "plan_superblocks",
+    "n_superblocks",
+    "plan_join",
 ]

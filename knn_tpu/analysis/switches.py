@@ -188,6 +188,21 @@ SWITCHES: Tuple[Switch, ...] = (
     _s("KNN_TPU_IVF_SEED", "int", "knn_tpu/ivf/index.py", _PERF,
        "Deterministic k-means init seed (default 0); same seed + data "
        "=> same placement."),
+    # --- bulk kNN-join engine (knn_tpu.join) ---------------------------
+    _s("KNN_TPU_JOIN_", "family", "knn_tpu/join/engine.py", _PERF,
+       "Bulk kNN-join knob family (superblock sizing + dispatch "
+       "depth); any ambient member is scrubbed by conftest.",
+       family=True),
+    _s("KNN_TPU_JOIN_SUPERBLOCK", "int", "knn_tpu/join/engine.py",
+       _PERF, "Query superblock rows of knn_join (unset = the h2d "
+       "staging-budget model, else 4096); explicit call args win."),
+    _s("KNN_TPU_JOIN_DEPTH", "int", "knn_tpu/join/engine.py", _PERF,
+       "Bounded dispatch-ahead depth of the double-buffered query "
+       "stream (default 2; 1 disables the overlap)."),
+    _s("KNN_TPU_JOIN_QUERY_BUDGET_BYTES", "int",
+       "knn_tpu/join/engine.py", _PERF,
+       "Host->device staging budget the superblock resolution sizes "
+       "against (analysis.hbm.plan_superblocks)."),
     # --- admission control (knn_tpu.serving.admission) -----------------
     _s("KNN_TPU_ADMISSION_", "family", "knn_tpu/serving/admission.py",
        _SERVING, "Admission-control knob family (ANY set member is an "
@@ -218,7 +233,8 @@ SWITCHES: Tuple[Switch, ...] = (
        "Named benchmark config: sift1m (default) | glove | gist1m."),
     _s("KNN_BENCH_MODES", "spec", "bench.py", _PERF,
        "Comma list of modes to run (exact, certified_approx, "
-       "certified_pallas, serving, knee, multihost, mutation)."),
+       "certified_pallas, serving, knee, multihost, mutation, ivf, "
+       "join)."),
     _s("KNN_BENCH_MULTIHOST_HOSTS", "int", "bench.py", _PERF,
        "Host-axis size of the multihost mode's hierarchical mesh "
        "(default 2)."),
@@ -333,6 +349,17 @@ SWITCHES: Tuple[Switch, ...] = (
        "Tenant mix spec, name[:weight[:priority]],..."),
     _s("KNN_BENCH_KNEE_SEED", "int", "bench.py", _PERF,
        "Workload-schedule seed."),
+    # --- bench.py: bulk kNN-join sweep (opt-in join mode) --------------
+    _s("KNN_BENCH_JOIN_", "family", "bench.py", _PERF,
+       "Join-sweep knob family of the opt-in join mode.", family=True),
+    _s("KNN_BENCH_JOIN_ROWS", "int", "bench.py", _PERF,
+       "Query rows of the join line's host-resident set A (0 = sized "
+       "from NQ/BATCH)."),
+    _s("KNN_BENCH_JOIN_SUPERBLOCK", "int", "bench.py", _PERF,
+       "Superblock rows of the join sweep (0 = the engine's "
+       "resolution ladder)."),
+    _s("KNN_BENCH_JOIN_DEPTH", "int", "bench.py", _PERF,
+       "Dispatch-ahead depth of the join sweep (default 2)."),
 )
 
 #: name -> Switch for exact lookups

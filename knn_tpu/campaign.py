@@ -81,9 +81,15 @@ ARM_KNOBS: Dict[str, Dict[str, object]] = {
     "bf16x3_streaming": {"precision": "bf16x3", "kernel": "streaming"},
     "int8_streaming": {"precision": "int8", "kernel": "streaming"},
     "int8_fused": {"precision": "int8", "kernel": "fused"},
+    # the bulk-join throughput regime (knn_tpu.join / PERF.md "Bulk
+    # kNN-join"): the tuning profile's block_q-512 ladder point, tiled
+    # because the deeper query blocks fit no other kernel's VMEM
+    # (tuning.knob_grid(profile="throughput"))
+    "join_bq512": {"precision": "bf16x3", "kernel": "tiled",
+                   "block_q": 512},
 }
 DEFAULT_ARMS = ("bf16x3_tiled", "bf16x3_streaming", "int8_streaming",
-                "int8_fused")
+                "int8_fused", "join_bq512")
 DEFAULT_REHEARSE_ARMS = ("bf16x3_tiled",)
 
 #: rehearse problem shape: big enough for a non-degenerate kernel

@@ -22,6 +22,17 @@ knob set to the on-disk cache, where every subsequent
 zero re-timing — the reproducible replacement for the per-session hand
 search of scripts/archive/tpu_session_r5b.py.
 
+    python -m knn_tpu.cli join --n 1000000 --rows 65536 --k 10
+    python -m knn_tpu.cli join --mode certified --superblock 8192
+
+runs the offline bulk kNN-join (knn_tpu.join): every row of a
+host-resident query set against the corpus through the double-buffered
+superblock stream (query h2d overlapped under device compute), or the
+certified per-superblock loop; prints plan + measured stats (rows/s,
+overlap_ratio, superblock/segment/dispatch counts) as one JSON line —
+the CLI face of bench.py's ``join`` mode (docs/PERF.md "Bulk kNN-join
+(MODEL_VERSION 7)").
+
     python -m knn_tpu.cli metrics --port 9100
     python -m knn_tpu.cli metrics --snapshot /path/run_metrics.json --format prom
 
@@ -244,6 +255,13 @@ def build_tune_parser() -> argparse.ArgumentParser:
     p.add_argument("--grid", default="standard",
                    choices=("quick", "standard", "full"),
                    help="knob grid size (tuning.knob_grid)")
+    p.add_argument("--profile", default="latency",
+                   choices=("latency", "throughput"),
+                   help="tuning regime (tuning.cache.PROFILES): "
+                   "'latency' is the serving grid/key; 'throughput' "
+                   "extends the grid with the bulk-join block_q "
+                   "512/1024 ladder and keys the winner separately so "
+                   "join winners never clobber serving winners")
     p.add_argument("--runs", type=int, default=2,
                    help="timed repetitions per candidate (fenced)")
     p.add_argument("--seed", type=int, default=0, help="synthetic data seed")
@@ -279,7 +297,7 @@ def run_tune(args: argparse.Namespace) -> int:
         db, queries, args.k, metric=args.metric, margin=args.margin,
         grid_level=args.grid, runs=args.runs, cache_path=args.cache,
         dtype=None if args.dtype == "float32" else args.dtype,
-        force=args.force,
+        force=args.force, profile=args.profile,
     )
     record = {**entry, "counters": tuning.counters()}
     if entry["cached"]:
@@ -295,6 +313,85 @@ def run_tune(args: argparse.Namespace) -> int:
     if args.json:
         with open(args.json, "w") as f:
             json.dump(record, f, indent=2)
+    return 0
+
+
+def build_join_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu join",
+        description="Bulk all-pairs kNN-join (knn_tpu.join): every row "
+        "of a host-resident query set joined against the corpus "
+        "through the double-buffered superblock stream (mode=stream) "
+        "or the exactness-certified per-superblock loop "
+        "(mode=certified).  Prints the plan + measured stats as one "
+        "JSON line.",
+    )
+    p.add_argument("--n", type=int, default=100_000, help="corpus rows (B)")
+    p.add_argument("--rows", type=int, default=16_384,
+                   help="query rows (A) — the join's outer set")
+    p.add_argument("--dim", type=int, default=128, help="feature dim")
+    p.add_argument("--k", type=int, default=10, help="neighbor count")
+    p.add_argument("--metric", default="l2",
+                   choices=("l2", "sql2", "euclidean", "cosine", "dot"))
+    p.add_argument("--mode", default="stream",
+                   choices=("stream", "certified"),
+                   help="stream = double-buffered raw top-k; certified "
+                   "= search_certified per superblock (exact, slower)")
+    p.add_argument("--superblock", type=int, default=None,
+                   help="query superblock rows (default: "
+                   "KNN_TPU_JOIN_SUPERBLOCK > h2d budget model > 4096)")
+    p.add_argument("--depth", type=int, default=None,
+                   help="dispatch-ahead depth (default: "
+                   "KNN_TPU_JOIN_DEPTH > 2)")
+    p.add_argument("--query-budget-bytes", type=int, default=None,
+                   help="size superblocks from this h2d staging budget "
+                   "(analysis.hbm.plan_superblocks)")
+    p.add_argument("--hbm-budget-bytes", type=int, default=None,
+                   help="force the host-RAM db tier with this device "
+                   "budget (exercises the db-major/query-major sweep "
+                   "nesting the byte model picks)")
+    p.add_argument("--seed", type=int, default=0, help="synthetic data seed")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="also write the stats record to this path")
+    p.add_argument("--cpu-devices", type=int, default=None, metavar="N",
+                   help="force an N-virtual-device CPU backend")
+    return p
+
+
+def run_join(args: argparse.Namespace) -> int:
+    """The `join` subcommand: synthetic data at the requested shape ->
+    knn_tpu.join.knn_join -> one human-readable summary + one JSON
+    line (the engine's stats dict: plan vs executed superblock/segment/
+    dispatch counts, overlap_ratio, rows/s)."""
+    import json
+
+    import numpy as np
+
+    from knn_tpu.join import knn_join
+    from knn_tpu.parallel import ShardedKNN
+    from knn_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(args.seed)
+    db = rng.random(size=(args.n, args.dim)).astype(np.float32)
+    qa = rng.random(size=(args.rows, args.dim)).astype(np.float32)
+    kw = {}
+    if args.hbm_budget_bytes is not None:
+        kw["hbm_budget_bytes"] = args.hbm_budget_bytes
+    prog = ShardedKNN(db, mesh=make_mesh(), k=args.k, metric=args.metric,
+                      **kw)
+    _, _, stats = knn_join(
+        prog, qa, mode=args.mode, superblock_rows=args.superblock,
+        depth=args.depth, query_budget_bytes=args.query_budget_bytes)
+    print(f"joined {stats['rows']} x {args.n} rows (k={args.k}, "
+          f"{args.metric}, {stats['mode']}): "
+          f"{stats['rows_per_s']} rows/s over "
+          f"{stats['superblocks']} superblocks x "
+          f"{stats['db_segments']} db segments "
+          f"({stats['order']}, overlap {stats['overlap_ratio']})")
+    print(json.dumps(stats))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(stats, f, indent=2)
     return 0
 
 
@@ -1227,6 +1324,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
             request_cpu_devices(targs.cpu_devices)
         return run_tune(targs)
+    if argv[:1] == ["join"]:
+        jargs = build_join_parser().parse_args(argv[1:])
+        if jargs.cpu_devices:
+            from knn_tpu.utils.compat import request_cpu_devices
+
+            request_cpu_devices(jargs.cpu_devices)
+        return run_join(jargs)
     if argv[:1] == ["lint"]:
         return run_lint(build_lint_parser().parse_args(argv[1:]))
     if argv[:1] == ["metrics"]:

@@ -67,6 +67,12 @@ class ResultLog:
         #: from the read outcomes above: a write's latency must never
         #: pollute the ADMITTED-read percentiles the SLO judges)
         self._writes: Dict[str, Dict[str, int]] = {}
+        #: bulk-join lane: outcome counts + ok latencies for ``bulk``
+        #: requests (offline join superblocks riding the schedule).
+        #: Same isolation contract as writes — the batch lane gets its
+        #: own section, the admitted-read percentiles stay query-only.
+        self._bulk: Dict[str, int] = {}
+        self._bulk_lat: deque = deque(maxlen=int(cap))
         #: (tenant, latency_s, trace_id) of ok-outcome requests, bounded
         #: with the records (percentiles are window truth, counts are
         #: lifetime); the trace id is what joins a knee artifact's tail
@@ -80,6 +86,11 @@ class ResultLog:
                 self._dropped += 1
             self._records.append(rec)
             out = rec["outcome"]
+            if kind == "bulk":
+                self._bulk[out] = self._bulk.get(out, 0) + 1
+                if out == "ok" and rec.get("latency_s") is not None:
+                    self._bulk_lat.append(rec["latency_s"])
+                return
             if kind != "query":
                 slot = self._writes.setdefault(kind, {})
                 slot[out] = slot.get(out, 0) + 1
@@ -103,6 +114,8 @@ class ResultLog:
                               for t, v in self._by_tenant.items()},
                 "writes": {k: dict(v)
                            for k, v in self._writes.items()},
+                "bulk": dict(self._bulk),
+                "bulk_latencies": list(self._bulk_lat),
                 "records_kept": len(self._records),
                 "records_dropped": self._dropped,
                 "latencies": list(self._lat),
@@ -149,7 +162,14 @@ def run_workload(target, requests: Sequence[Request], *, queries,
     oldest still-live inserted id (none live yet -> the explicit
     ``skipped:no_live_id`` outcome, never an error).  Their outcomes
     land in the log's ``writes`` section and NEVER in the admitted-read
-    latency percentiles."""
+    latency percentiles.
+
+    Bulk requests (``Request.kind`` == ``bulk`` — the TenantSpec
+    ``bulk_fraction`` lane, offline join superblocks mixed into the
+    serving schedule) are READS: they ride ``target.submit`` and the
+    same admission control as queries, but their outcomes and latencies
+    land in the report's ``bulk`` section — the interactive read-side
+    percentiles stay query-only either way."""
     if not requests:
         raise ValueError("empty request schedule")
     if submitters < 1 or waiters < 1:
@@ -162,7 +182,7 @@ def run_workload(target, requests: Sequence[Request], *, queries,
         raise ValueError(
             f"queries pool has {pool.shape[0]} rows; schedule needs "
             f"{max_rows}")
-    has_writes = any(r.kind != "query" for r in requests)
+    has_writes = any(r.kind in ("insert", "delete") for r in requests)
     if has_writes and not hasattr(target, "submit_write"):
         raise ValueError(
             f"schedule carries write ops but target "
@@ -226,9 +246,15 @@ def run_workload(target, requests: Sequence[Request], *, queries,
                 "deadline_ms": r.deadline_ms,
                 "priority": r.priority,
             }
-            if r.kind != "query":
+            if r.kind in ("insert", "delete"):
                 _submit_write(r, t_sub, base)
                 continue
+            if r.kind == "bulk":
+                # a bulk-join superblock is a READ — it rides the same
+                # submit path and admission control as queries, only its
+                # outcome is logged into the batch lane, never the
+                # admitted-read percentiles
+                base["kind"] = "bulk"
             try:
                 fut = target.submit(
                     pool[: r.rows], tenant=r.tenant,
@@ -318,13 +344,16 @@ def report(log: ResultLog, *, offered: int, wall_s: float) -> dict:
     """Aggregate the log: overall + per-tenant outcome counts, ADMITTED
     latency percentiles, achieved q/s, shed fraction.  Schedules with a
     write stream also carry a ``writes`` section (per-kind outcome
-    counts); every read-side number — offered, shed fraction,
-    percentiles — covers QUERIES only, so a write mix can never dilute
-    the admitted-read latency story."""
+    counts), and schedules with a bulk-join lane a ``bulk`` section
+    (outcomes + the batch lane's own latency summary); every read-side
+    number — offered, shed fraction, percentiles — covers QUERIES
+    only, so neither mix can dilute the admitted-read latency story."""
     snap = log.snapshot()
     writes = snap.get("writes") or {}
     n_writes = sum(sum(v.values()) for v in writes.values())
-    offered -= n_writes  # read-side offered: queries only
+    bulk = snap.get("bulk") or {}
+    n_bulk = sum(bulk.values())
+    offered -= n_writes + n_bulk  # read-side offered: queries only
     outcomes = snap["outcomes"]
     ok = outcomes.get("ok", 0)
     rejected = sum(v for k, v in outcomes.items()
@@ -380,6 +409,17 @@ def report(log: ResultLog, *, offered: int, wall_s: float) -> dict:
             "total": n_writes,
             "ok": sum(v.get("ok", 0) for v in writes.values()),
         }} if writes else {}),
+        # bulk-join batch lane (kind == "bulk"): its own outcome
+        # counts and latency summary, present only when the schedule
+        # carried bulk superblocks — the join/serving interference
+        # record, kept beside (never inside) the read-side percentiles
+        **({"bulk": {
+            "outcomes": dict(bulk),
+            "total": n_bulk,
+            "ok": bulk.get("ok", 0),
+            "latency_ms": _percentiles_ms(snap.get("bulk_latencies")
+                                          or []),
+        }} if bulk else {}),
         "records_kept": snap["records_kept"],
         "records_dropped": snap["records_dropped"],
     }

@@ -70,6 +70,14 @@ class TenantSpec:
     insert_fraction: float = 0.0
     delete_fraction: float = 0.0
     write_rows: int = 1
+    #: offline bulk-join lane: with probability ``bulk_fraction`` a
+    #: scheduled request is a ``bulk`` read of ``bulk_rows`` rows — a
+    #: join superblock riding the serving schedule, the mixed
+    #: join/serving interference shape.  Bulk outcomes land in their
+    #: own report section; the admitted-read percentiles never see
+    #: them.  Zero = the pre-bulk schedule, draw for draw.
+    bulk_fraction: float = 0.0
+    bulk_rows: int = 1024
 
     def validate(self) -> None:
         if self.weight <= 0:
@@ -85,15 +93,22 @@ class TenantSpec:
                 f"tenant {self.name!r}: deadline_ms must be > 0, got "
                 f"{self.deadline_ms}")
         if self.insert_fraction < 0 or self.delete_fraction < 0 \
-                or self.insert_fraction + self.delete_fraction > 1:
+                or self.bulk_fraction < 0 \
+                or (self.insert_fraction + self.delete_fraction
+                        + self.bulk_fraction) > 1:
             raise ValueError(
-                f"tenant {self.name!r}: write fractions must be >= 0 "
+                f"tenant {self.name!r}: kind fractions must be >= 0 "
                 f"and sum to <= 1, got insert={self.insert_fraction} "
-                f"delete={self.delete_fraction}")
+                f"delete={self.delete_fraction} "
+                f"bulk={self.bulk_fraction}")
         if self.write_rows < 1:
             raise ValueError(
                 f"tenant {self.name!r}: write_rows must be >= 1, got "
                 f"{self.write_rows}")
+        if self.bulk_rows < 1:
+            raise ValueError(
+                f"tenant {self.name!r}: bulk_rows must be >= 1, got "
+                f"{self.bulk_rows}")
 
 
 @dataclass(frozen=True)
@@ -110,9 +125,10 @@ class Request:
     precision: Optional[str] = None
     deadline_ms: Optional[float] = None
     priority: int = 0
-    #: "query" | "insert" | "delete" — writes ride the same seeded
-    #: open-loop schedule as reads (TenantSpec write fractions); old
-    #: traces without the field load as pure-query schedules
+    #: "query" | "insert" | "delete" | "bulk" — writes and bulk-join
+    #: superblocks ride the same seeded open-loop schedule as reads
+    #: (TenantSpec kind fractions); old traces without the field load
+    #: as pure-query schedules
     kind: str = "query"
 
 
@@ -232,19 +248,25 @@ def generate(spec: WorkloadSpec) -> List[Request]:
         rows = int(ten.batch_sizes[int(
             rng.integers(0, len(ten.batch_sizes)))])
         kind = "query"
-        if ten.insert_fraction > 0 or ten.delete_fraction > 0:
-            # the kind draw happens ONLY for write-mixed tenants, so a
-            # write-free spec's rng sequence — and therefore its whole
+        if ten.insert_fraction > 0 or ten.delete_fraction > 0 \
+                or ten.bulk_fraction > 0:
+            # the kind draw happens ONLY for mixed tenants, so a
+            # pure-query spec's rng sequence — and therefore its whole
             # schedule — is unchanged draw for draw (pinned)
             u = float(rng.random())
             if u < ten.insert_fraction:
                 kind = "insert"
             elif u < ten.insert_fraction + ten.delete_fraction:
                 kind = "delete"
+            elif u < (ten.insert_fraction + ten.delete_fraction
+                      + ten.bulk_fraction):
+                kind = "bulk"
         if kind == "insert":
             rows = ten.write_rows
         elif kind == "delete":
             rows = 1
+        elif kind == "bulk":
+            rows = ten.bulk_rows
         out.append(Request(
             tenant=ten.name, t=round(float(t), 6), rows=rows, k=ten.k,
             metric=ten.metric, precision=ten.precision,

@@ -122,12 +122,25 @@ from knn_tpu.obs import names, registry, trace
 #: IVF composition (probed blocks gather PQ codes).  The bump re-keys
 #: the tuning cache and calibration store so v5 attributions
 #: self-invalidate.
-MODEL_VERSION = 6
+#: 7 = the bulk kNN-join model (:func:`join_cost_model`): a joined
+#: superblock of S query rows streams the db ONCE per dispatch, so the
+#: modeled db HBM bytes PER QUERY fall as 1/S (the amortization the
+#: join engine exists for) until another term binds; the block gains a
+#: ``terms.h2d`` entry pricing the host->device stream the byte model
+#: plans (analysis.hbm.plan_join's winning nesting order) against the
+#: host-link bandwidth (H2D_GBPS_* — the PCIe attach, not HBM), and
+#: because the engine double-buffers, h2d OVERLAPS device compute:
+#: steady-state time is ``max(t_device, t_h2d)`` and ``bound_class``
+#: can read the new ``h2d_bound``.  Serving blocks are numerically
+#: unchanged; the bump re-keys the tuning cache (rl7) and calibration
+#: store (cal7) so v6 attributions self-invalidate.
+MODEL_VERSION = 7
 
 #: the resources a config can exhaust, in tie-break order (dcn_bound
-#: only appears on multi-host blocks, db_hosts > 1)
+#: only appears on multi-host blocks, db_hosts > 1; h2d_bound only on
+#: join blocks, where the query stream's host link can bind)
 BOUND_CLASSES = ("hbm_bound", "mxu_bound", "vpu_select_bound",
-                 "dcn_bound")
+                 "dcn_bound", "h2d_bound")
 
 #: per-device-kind peaks (public spec sheets; bf16 column = the table
 #: bench.py carried since round 1, now living here).  ``hbm_gbps`` is
@@ -200,6 +213,28 @@ def dcn_gbps_for(device_kind, peaks) -> float:
     if peaks and "dcn_gbps" in peaks:
         return float(peaks["dcn_gbps"])
     return DCN_GBPS_BY_KIND.get(device_kind or "", DCN_GBPS_DEFAULT)
+
+
+#: host->device link bandwidth (GB/s) for the join model's h2d query-
+#: stream term — the PCIe attach between the host's RAM (where a
+#: super-HBM query set lives) and the chip, NOT HBM.  ESTIMATED from
+#: public attach generations (gen3 x16 ~16 GB/s on v2/v3 era hosts,
+#: gen4+ on later kinds); like ``vpu_ops``/``dcn_gbps`` these rank
+#: configurations and name the bound, not defend a digit.  Kinds
+#: absent here fall back to H2D_GBPS_DEFAULT.
+H2D_GBPS_BY_KIND: Dict[str, float] = {
+    "TPU v2": 8.0, "TPU v3": 8.0,
+}
+H2D_GBPS_DEFAULT = 16.0
+
+
+def h2d_gbps_for(device_kind, peaks) -> float:
+    """The host->device bandwidth a join block's h2d term divides by:
+    an explicit ``h2d_gbps`` in a caller-supplied peaks dict wins, else
+    the kind table, else the gen4-attach default."""
+    if peaks and "h2d_gbps" in peaks:
+        return float(peaks["h2d_gbps"])
+    return H2D_GBPS_BY_KIND.get(device_kind or "", H2D_GBPS_DEFAULT)
 
 #: db operand stream width per element, by kernel matmul precision —
 #: EXACTLY what ops.pallas_knn._bin_candidates builds, living since
@@ -793,6 +828,94 @@ def cost_model(*, selector: str = "pallas", **kwargs) -> dict:
     if selector == "pallas":
         return pallas_cost_model(**kwargs)
     return xla_cost_model(selector=selector, **kwargs)
+
+
+def join_cost_model(
+    *, n_a: int, n_b: int, d: int, k: int, superblock_rows: int,
+    selector: str = "exact", db_segment_rows: int = 0,
+    device_kind: Optional[str] = None, backend: Optional[str] = None,
+    num_devices: int = 1, peaks: Optional[Dict[str, float]] = None,
+    db_hosts: int = 1, dcn_merge: Optional[str] = None,
+    **selector_kwargs,
+) -> dict:
+    """The MODEL_VERSION-7 bulk kNN-join roofline: ``n_a`` query rows
+    joined against an ``n_b``-row corpus in superblocks of
+    ``superblock_rows``, per the join engine's execution shape
+    (knn_tpu.join.engine).
+
+    The device-side terms are the serving cost model of ONE superblock
+    dispatch — ``nq = superblock_rows`` and (for the XLA selectors the
+    stream path actually runs) ``batch = superblock_rows``, so the db
+    streams ONCE per superblock and the modeled db HBM bytes PER QUERY
+    are ``db_bytes / superblock_rows`` — the 1/S amortization, falling
+    until ``bound_class`` flips off ``hbm_bound`` to whichever term
+    stops shrinking (mxu, usually).  On top, ``terms.h2d`` prices the
+    host->device stream :func:`knn_tpu.analysis.hbm.plan_join` plans
+    (queries, plus the db segments when B is host-tiered, at the
+    winning nesting order) against :func:`h2d_gbps_for`; the engine
+    double-buffers, so the steady-state per-superblock time is
+    ``max(t_device, t_h2d)`` — an h2d stream slower than compute makes
+    the block ``h2d_bound``.  ``ceiling_qps`` is the steady-state JOIN
+    throughput in rows of A per second; the analytic verdict stands
+    (calibration entries cover serving shapes, so the block carries an
+    explicit skip note)."""
+    from knn_tpu.analysis import hbm as _hbm
+
+    sb = int(superblock_rows)
+    if sb < 1:
+        raise ValueError(f"superblock_rows must be >= 1, got {sb}")
+    base_kw = dict(
+        n=n_b, d=d, k=k, nq=sb, device_kind=device_kind,
+        backend=backend, num_devices=num_devices, peaks=peaks,
+        db_hosts=db_hosts, dcn_merge=dcn_merge, **selector_kwargs)
+    if selector in ("exact", "approx"):
+        # one superblock = one chunk: the whole point of the regime
+        base_kw.setdefault("batch", sb)
+    model = cost_model(selector=selector, **base_kw)
+    plan = _hbm.plan_join(n_a, n_b, d, superblock_rows=sb,
+                          db_segment_rows=db_segment_rows)
+    s = plan["superblocks"]
+    h2d_total = plan["h2d_bytes"][plan["order"]]
+    rate = h2d_gbps_for(device_kind, peaks)
+    per_sb = h2d_total / s
+    t_h2d = per_sb / (rate * 1e9)
+    # re-derive the device combined time from the ANALYTIC term times
+    # (a serving calibration entry fit a different batch shape; the
+    # join verdict stays analytic, explicitly)
+    times = dict(model["term_times_s"])
+    t_dev = _combined(times, model.get("select_overlapped", False))
+    t_sb = max(t_dev, t_h2d)
+    hbm_b = model["terms"]["hbm"]["bytes"]
+    model["terms"]["h2d"] = {
+        "bytes": int(per_sb),
+        "total_bytes": int(h2d_total),
+        "rate_gbps": rate,
+        "time_s": t_h2d,
+        "overlapped": True,  # double buffering hides the smaller side
+    }
+    model["join"] = {
+        "n_a": int(n_a),
+        "superblock_rows": sb,
+        "superblocks": int(s),
+        "db_segments": int(plan["db_segments"]),
+        "order": plan["order"],
+        # the amortization headline: db HBM bytes each query costs
+        "db_bytes_per_query": (hbm_b["db_stream"] + hbm_b["db_aux"])
+        / sb,
+        "h2d_bytes_per_query": h2d_total / max(1, int(n_a)),
+        "rows_per_s_ceiling": round(sb / t_sb, 1) if t_sb > 0 else None,
+    }
+    times["h2d_bound"] = t_h2d
+    model["bound_class"] = max(
+        times, key=lambda c: (times[c], -BOUND_CLASSES.index(c)))
+    model["ceiling_qps"] = round(sb / t_sb, 1) if t_sb > 0 else None
+    model["ceiling_qps_analytic"] = model["ceiling_qps"]
+    model["term_times_s"] = {c: round(v, 6) for c, v in times.items()}
+    model.pop("term_times_calibrated_s", None)
+    model["calibration"] = {
+        "applied": False,
+        "note": "join blocks use the analytic h2d model"}
+    return model
 
 
 def attribute(model: dict, measured_qps: Optional[float]) -> dict:

@@ -345,6 +345,39 @@ def segment_search_program(
                              dtype_key, dcn_merge=dcn_merge)
 
 
+def query_stream_program(
+    mesh: Mesh,
+    k: int,
+    n_train: int,
+    metric: str = "l2",
+    merge: Optional[str] = None,
+    *,
+    train_tile: Optional[int] = None,
+    compute_dtype=None,
+    dcn_merge: Optional[str] = None,
+    donate: bool = False,
+):
+    """Public handle on the resident-db search program for callers that
+    stream QUERY superblocks instead of serving one request batch — the
+    bulk kNN-join engine (knn_tpu.join): superblock i+1's host->device
+    query transfer overlaps superblock i's device compute under the
+    bounded-depth drain-oldest discipline, and ``donate=True`` donates
+    each superblock's query placement so HBM recycles block-over-block
+    instead of accumulating across the dispatch-ahead window (CPU XLA
+    rejects donation; callers pass False there — the same contract as
+    :func:`_hosttier_program`'s segment donation).  The returned
+    callable is ``prog(qp, tp)`` with the :func:`_knn_program` contract
+    (shared lru compile cache: a join stream and a serving placement of
+    the same shape share one executable when neither donates)."""
+    _, chips = db_topology(mesh)
+    merge, _src = crossover.resolve_merge(merge, k, chips)
+    dtype_key = (
+        None if compute_dtype is None else jnp.dtype(compute_dtype).name
+    )
+    return _knn_program(mesh, k, metric, merge, n_train, train_tile,
+                        dtype_key, donate=donate, dcn_merge=dcn_merge)
+
+
 #: bounded-retry policy for transient device failures inside long sweeps
 #: (SURVEY §5 failure row; the same per-batch unit streaming.py uses).
 #: ValueError/TypeError are caller bugs and never retried.  Waits double
@@ -523,14 +556,17 @@ class ShardedKNN:
         obs.install_compile_hook()
         metric = metric.lower()  # dispatch below compares lowercase names
         self._cosine_unit = False  # db rows normalized at placement?
+        self._dot_aug = False  # db rows norm-augmented at placement?
+        self._dot_shift = 0.0  # M = max f64 squared row norm (dot only)
         #: uint8 source rows (SIFT-style bvecs payloads): kept so an int8
         #: coarse pass reuses the bytes EXACTLY (unit scale, -128 shift —
         #: ops.quantize.from_uint8) instead of round-tripping through f32
-        #: quantization.  Cosine normalizes rows at placement, so the
-        #: byte-exact shortcut doesn't apply there.
+        #: quantization.  Cosine normalizes rows at placement and dot
+        #: appends a non-byte augmentation column, so the byte-exact
+        #: shortcut doesn't apply there.
         self._uint8_train = None
         if (isinstance(train, np.ndarray) and train.dtype == np.uint8
-                and metric != "cosine"):
+                and metric not in ("cosine", "dot")):
             self._uint8_train = train
             train = train.astype(np.float32)
         #: lazily built int8 db placement (quantized values + scales +
@@ -583,6 +619,27 @@ class ShardedKNN:
                 # themselves (norm clamped).
                 train = _row_normalize_f64(train)
                 self._cosine_unit = True
+            elif metric == "dot" and isinstance(train, np.ndarray):
+                # MIPS -> L2 by norm augmentation, ONCE at placement:
+                # appending sqrt(M - ||t||^2) to every row (M = max f64
+                # squared row norm) and a zero column to every query makes
+                # the augmented squared L2
+                #   ||q'-t'||^2 = ||q||^2 + M - 2 q.t
+                # an affine, strictly decreasing map of the inner product
+                # per query — the augmented-L2 ranking IS the MIPS
+                # ranking, so the whole certified-exact machinery
+                # (search_certified, any precision x kernel) applies.
+                # Plain search rides too: _place_queries appends the zero
+                # column and the extra 0*aug term leaves pairwise_dot
+                # values mathematically unchanged.
+                train = np.asarray(train, np.float32)
+                t64 = train.astype(np.float64)
+                norm2 = np.einsum("nd,nd->n", t64, t64)
+                self._dot_shift = float(norm2.max()) if norm2.size else 0.0
+                aug = np.sqrt(np.maximum(self._dot_shift - norm2, 0.0))
+                train = np.concatenate(
+                    [train, aug[:, None].astype(np.float32)], axis=1)
+                self._dot_aug = True
             # host copy (unpadded) for certified-path float64 refinement
             self._train_host = train if isinstance(train, np.ndarray) else None
             # pad rows with a huge fill: every selector also masks them by
@@ -681,6 +738,9 @@ class ShardedKNN:
         self._db_norm_max_cache: Optional[float] = None
         self.train_tile = train_tile
         self.n_train = n_train
+        #: user-facing query/input dim — dot placements append one norm-
+        #: augmentation column, so the PLACED width is ``dim_in + 1``
+        self.dim_in = int(tp.shape[1]) - (1 if self._dot_aug else 0)
         self._dtype_key = (
             None if compute_dtype is None else jnp.dtype(compute_dtype).name
         )
@@ -770,6 +830,15 @@ class ShardedKNN:
     def _place_queries(self, queries):
         if not isinstance(queries, jax.Array):
             queries = np.asarray(queries)
+            if (self._dot_aug and queries.ndim == 2
+                    and queries.shape[1] == self.dim_in):
+                # dot placements are norm-augmented: queries ride with a
+                # zero column (q'.t' == q.t).  Already-augmented callers
+                # (search_certified) arrive at width dim_in + 1 and pass
+                # through untouched.
+                queries = np.concatenate(
+                    [np.asarray(queries, np.float32),
+                     np.zeros((queries.shape[0], 1), np.float32)], axis=1)
         qp, n_q = pad_to_multiple(queries, self.mesh.shape[QUERY_AXIS])
         return shard(qp, self.mesh, QUERY_AXIS), n_q
 
@@ -1319,12 +1388,16 @@ class ShardedKNN:
         overlap_depth: Optional[int] = None,
     ):
         """Exact lexicographic top-k via the certified pipeline, sharded.
-        Returns (dists_f64, idx, stats).  L2 and cosine (the certificate
-        is a squared-L2 bound; cosine runs it on unit vectors — rows are
-        normalized at placement, queries here — and is exact for the
-        f32-row-normalized problem, distances returned as 1-similarity).
-        L1 has no squared-L2-style bound and stays uncertified.  Two
-        certificate strategies by ``selector``:
+        Returns (dists_f64, idx, stats).  L2, cosine and dot (the
+        certificate is a squared-L2 bound; cosine runs it on unit
+        vectors — rows are normalized at placement, queries here — and
+        is exact for the f32-row-normalized problem, distances returned
+        as 1-similarity; dot/MIPS runs it on the norm-AUGMENTED vectors
+        placed at construction — one extra column per row — and is
+        exact for the f32-augmented problem, distances mapped back to
+        pairwise_dot's negative-inner-product values).  L1 has no
+        squared-L2-style bound and stays uncertified.  Two certificate
+        strategies by ``selector``:
 
         - ``"approx"`` / ``"exact"``: coarse top-(k+margin), float64 host
           refine, then a distributed count-below pass (psum over the db
@@ -1425,9 +1498,25 @@ class ShardedKNN:
                     "(pre-placed arrays arrive already sharded, so "
                     "row-normalize them and use metric='l2' instead)"
                 )
+        elif self.metric == "dot":
+            # MIPS runs the l2 certificate in the norm-augmented space
+            # built at placement (__init__): the augmented-L2 ranking is
+            # the inner-product ranking per query (affine map), so the
+            # certificate is EXACT for the f32-augmented problem; scores
+            # map back to pairwise_dot values (negative inner product)
+            # below.
+            if not self._dot_aug:
+                raise ValueError(
+                    "dot search_certified needs the norm-augmented "
+                    "placement built at construction; construct ShardedKNN "
+                    "from a host array (pre-placed arrays arrive already "
+                    "sharded — augment the rows yourself and use "
+                    "metric='l2' instead)"
+                )
         elif self.metric not in ("l2", "sql2", "euclidean"):
             raise ValueError(
-                "search_certified supports the l2 and cosine metrics only")
+                "search_certified supports the l2, cosine and dot "
+                "metrics only")
         if selector not in SELECTORS:
             raise ValueError(f"unknown selector {selector!r}; expected {SELECTORS}")
         from knn_tpu.ops.certified import repair_uncertified
@@ -1435,9 +1524,20 @@ class ShardedKNN:
         q_np = np.asarray(queries, dtype=np.float32)
         if self.metric == "cosine":
             q_np = _row_normalize_f64(q_np)
+        q_norm2 = None
+        if self.metric == "dot":
+            # augment queries with the zero column matching the placed
+            # rows' augmentation; keep per-query f64 ||q||^2 for the
+            # score back-map at the end
+            q64 = q_np.astype(np.float64)
+            q_norm2 = np.einsum("nd,nd->n", q64, q64)
+            q_np = np.concatenate(
+                [q_np, np.zeros((q_np.shape[0], 1), np.float32)], axis=1)
         # every certified stage runs in squared-L2 space (for cosine: on
-        # the unit vectors placed at construction / normalized above)
-        cert_metric = "l2" if self.metric == "cosine" else self.metric
+        # the unit vectors placed at construction / normalized above;
+        # for dot: on the norm-augmented vectors)
+        cert_metric = ("l2" if self.metric in ("cosine", "dot")
+                       else self.metric)
         n_q = q_np.shape[0]
         shard_rows = self._shard_rows()
         # margin is bounded by both the db size and the per-shard rows the
@@ -1543,6 +1643,16 @@ class ShardedKNN:
         if return_distances and self.metric == "cosine":
             # unit-vector squared L2 -> cosine distance values, exactly
             # (matches pairwise_cosine's 1 - similarity convention)
+            d *= 0.5
+        if return_distances and self.metric == "dot":
+            # augmented-space squared L2 -> pairwise_dot values (negative
+            # inner product): invert the affine map in f64 —
+            # ||q'-t'||^2 = ||q||^2 + M - 2 q.t, so
+            # -q.t = (||q'-t'||^2 - ||q||^2 - M) / 2.  Indices and
+            # certification are unaffected (the map is monotone per
+            # query); values then flow through metric_values like any
+            # other metric (dot passes through).
+            d -= q_norm2[:, None] + self._dot_shift
             d *= 0.5
         if return_distances and return_sqrt:
             # true Euclidean values (knn_mpi.cpp:48 / sklearn convention);

@@ -206,7 +206,11 @@ class ServingEngine:
             # AOT-compile against — refuse with the tier's own message
             # instead of a cryptic NoneType AttributeError below
             program._require_resident("ServingEngine")
-        self._dim = int(program._tp.shape[1])
+        #: user-facing request dim (what submit validates/pads against);
+        #: dot placements are norm-augmented, so the PLACED width below
+        #: is one wider — _place_queries appends the zero column
+        self._dim = int(getattr(program, "dim_in", program._tp.shape[1]))
+        self._placed_dim = int(program._tp.shape[1])
         self._lock = threading.Lock()
         self._execs: Dict[Tuple[str, int], object] = {}
         #: per-key in-flight compile events (see _executable)
@@ -305,7 +309,7 @@ class ServingEngine:
                 fn = self._jit_fn(op)
                 if self._aot:
                     q_spec = jax.ShapeDtypeStruct(
-                        (key[1], self._dim), np.float32,
+                        (key[1], self._placed_dim), np.float32,
                         sharding=NamedSharding(self.program.mesh, P(QUERY_AXIS)),
                     )
                     try:
@@ -539,9 +543,11 @@ class ServingEngine:
 
             p = self.program
             # the same key search_certified resolves with: the cosine
-            # certificate runs on unit vectors under the l2 kernel, so
-            # its winners are keyed (and must be looked up) as l2
-            cert_metric = "l2" if p.metric == "cosine" else p.metric
+            # certificate runs on unit vectors and the dot/MIPS one on
+            # norm-augmented vectors, both under the l2 kernel, so
+            # their winners are keyed (and must be looked up) as l2
+            cert_metric = ("l2" if p.metric in ("cosine", "dot")
+                           else p.metric)
             knobs, info = tuning.resolve_full(
                 p.n_train, self._dim, self.k, metric=cert_metric,
                 dtype=p._dtype_key)

@@ -32,6 +32,7 @@ from knn_tpu.tuning.autotune import (
 )
 from knn_tpu.tuning.cache import (
     CACHE_ENV,
+    PROFILES,
     TuneCache,
     cache_key,
     default_cache_path,
@@ -51,6 +52,7 @@ __all__ = [
     "resolve",
     "resolve_full",
     "CACHE_ENV",
+    "PROFILES",
     "TuneCache",
     "cache_key",
     "default_cache_path",

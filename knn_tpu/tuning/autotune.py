@@ -39,7 +39,8 @@ import numpy as np
 from knn_tpu import obs
 from knn_tpu.analysis import vmem as _vmem
 from knn_tpu.obs import names as _mn
-from knn_tpu.tuning.cache import TuneCache, cache_key, default_cache_path
+from knn_tpu.tuning.cache import (PROFILES, TuneCache, cache_key,
+                                  default_cache_path)
 
 #: the knob names resolve() returns — exactly the kernel-shaping
 #: keyword arguments of ShardedKNN.search_certified's pallas selector.
@@ -133,17 +134,20 @@ def resolve_full(
     n: int, d: int, k: int, *, metric: str = "l2",
     dtype: Optional[str] = None, device_kind: Optional[str] = None,
     overrides: Optional[Dict[str, object]] = None,
-    cache_path: Optional[str] = None,
+    cache_path: Optional[str] = None, profile: str = "latency",
 ) -> Tuple[Dict[str, object], Dict[str, object]]:
     """(knobs, info): the knob set for one problem shape plus its
     provenance.  Precedence: explicit overrides (non-None values) >
     cached winner > ``DEFAULT_KNOBS``.  ``info`` carries ``source``
     ("cache" | "default"), the cache key/path, and which knobs an
-    override pinned — the observability bench/serving surface."""
+    override pinned — the observability bench/serving surface.
+    ``profile`` selects the tuning regime's cache row (latency =
+    serving, throughput = bulk join; see :func:`cache_key`) — a miss
+    in either row falls back to the same ``DEFAULT_KNOBS``."""
     _bump("resolve_calls")
     if device_kind is None:
         device_kind = _device_kind()
-    key = cache_key(device_kind, n, d, k, metric, dtype)
+    key = cache_key(device_kind, n, d, k, metric, dtype, profile)
     cache = TuneCache(cache_path)
     knobs = dict(DEFAULT_KNOBS)
     entry = cache.get(key)
@@ -168,6 +172,7 @@ def resolve_full(
         "source": source,
         "cache_key": key,
         "cache_path": cache.path,
+        "profile": profile,
         "overridden": sorted(overridden),
     }
     if source == "cache":
@@ -206,7 +211,8 @@ def _label(knobs: Dict[str, object]) -> str:
     return ",".join(parts) or "defaults"
 
 
-def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
+def knob_grid(level: str = "standard",
+              profile: str = "latency") -> List[Dict[str, object]]:
     """The bounded, deterministic candidate grid.
 
     - ``"quick"``: kernel x grid_order at default geometry, plus the
@@ -244,10 +250,26 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
     into the cache — consumers with their own final_select preference
     (bench.py's historical relay-side "approx") yield to a cache hit
     precisely because the hit measured it.
+
+    ``profile`` (:data:`knn_tpu.tuning.cache.PROFILES`) picks the
+    tuning regime.  ``"latency"`` (default) is the grid above,
+    byte-identical to the pre-profile output.  ``"throughput"`` is the
+    bulk kNN-join regime (knn_tpu.join): the same candidates PLUS a
+    block_q 512/1024 ladder — at join superblock sizes the query grid
+    is deep enough that larger query blocks amortize db-tile reloads a
+    latency tune never sees.  The ladder is tiled-kernel only: the
+    streaming/fused score blocks alone price block_q x tile_n x 4 B
+    over EVERY known device kind's VMEM at block_q >= 512
+    (knn_tpu.analysis.vmem at the headline shape; the ``vmem-budget``
+    checker sweeps this profile's full grid too, so a fits-nowhere arm
+    added here fails the lint at authoring time).
     """
     if level not in ("quick", "standard", "full"):
         raise ValueError(f"grid level {level!r} not in "
                          f"('quick', 'standard', 'full')")
+    if profile not in PROFILES:
+        raise ValueError(f"unknown tuning profile {profile!r}; "
+                         f"expected one of {PROFILES}")
     out: List[Dict[str, object]] = []
     seen = set()
 
@@ -276,16 +298,47 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
             # like this sneaks back in).  The block_q=128 variants
             # price at ~96 MB, fit v4+, and stay in the grid.
             return
+        if (knobs["kernel"] in ("streaming", "fused")
+                and (knobs["block_q"] or 128) >= 512):
+            # throughput-ladder block_q: the streaming/fused per-launch
+            # score block alone (block_q x tile x 4 B plus the resident
+            # db slab) prices over EVERY known device kind's VMEM at
+            # every authored tile_n/precision (same fits-nowhere
+            # analysis as above; vmem-budget checker-pinned).  The
+            # tiled kernel re-blocks queries against a single db tile
+            # and is the only kernel the 512/1024 ladder can reach.
+            return
         lbl = _label(knobs)
         if lbl not in seen:
             seen.add(lbl)
             out.append(knobs)
+
+    def extend_throughput():
+        # the bulk-join regime's large-block arms (tiled only — see the
+        # authored exclusion above): block_q deviations alone, their
+        # approx-select cross, the tile ladder, and the quantized-db
+        # precisions whose smaller streamed bytes pair naturally with
+        # deeper query blocks.  Every arm fits at least one device kind
+        # at the headline shape (vmem.fits_some_kind; checker-swept).
+        for bq in (512, 1024):
+            add(block_q=bq)
+            add(block_q=bq, final_select="approx")
+            add(block_q=bq, tile_n=8192)
+            for prec in ("bf16x3f", "int8", "int4"):
+                add(block_q=bq, precision=prec)
+        # the largest-tile cross stops at block_q=512: at 1024 the f32
+        # score block alone is 1024 x 32768 x 4 B = 128 MB — the WHOLE
+        # largest known VMEM before operands/carry, fits nowhere
+        add(block_q=512, tile_n=32768)
+        add(block_q=512, precision="int8", tile_n=32768)
 
     for kern in ("tiled", "streaming", "fused"):
         for order in ("query_major", "db_major"):
             add(kernel=kern, grid_order=order)
     add(final_select="approx")
     if level == "quick":
+        if profile == "throughput":
+            extend_throughput()
         return out
     for tile in (8192, 32768):
         add(tile_n=tile)
@@ -309,6 +362,8 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
     add(precision="int8", kernel="fused")
     add(kernel="fused", tile_n=32768)
     if level == "standard":
+        if profile == "throughput":
+            extend_throughput()
         return out
     # block_q enumerates EXPLICIT values: None would fall back to the
     # kernel-module default (128) and silently duplicate the 128 point
@@ -322,6 +377,8 @@ def knob_grid(level: str = "standard") -> List[Dict[str, object]]:
             kernel=kern)
         add(tile_n=tile, block_q=bq, grid_order=order, precision=prec,
             kernel=kern, final_select="approx")
+    if profile == "throughput":
+        extend_throughput()
     return out
 
 
@@ -545,7 +602,7 @@ def autotune(
     grid_level: str = "standard", runs: int = 2,
     cache_path: Optional[str] = None, device_kind: Optional[str] = None,
     dtype: Optional[str] = None, force: bool = False,
-    prune: Optional[float] = None,
+    prune: Optional[float] = None, profile: str = "latency",
 ) -> Dict[str, object]:
     """Search the knob grid for ``(db, queries, k, metric)`` and persist
     the winner; returns the cache entry (plus ``"cached": True`` when a
@@ -612,7 +669,7 @@ def autotune(
     n, d = db.shape
     if device_kind is None:
         device_kind = _device_kind()
-    key = cache_key(device_kind, n, d, k, metric, dtype)
+    key = cache_key(device_kind, n, d, k, metric, dtype, profile)
     cache = TuneCache(cache_path)
     if not force:
         entry = cache.get(key)
@@ -622,7 +679,8 @@ def autotune(
                     "cache_path": cache.path}
 
     _bump("tune_searches")
-    candidates = list(grid) if grid is not None else knob_grid(grid_level)
+    candidates = (list(grid) if grid is not None
+                  else knob_grid(grid_level, profile))
     for c in candidates:
         unknown = set(c) - set(DEFAULT_KNOBS)
         if unknown:
@@ -808,6 +866,7 @@ def autotune(
         "errors": errors,
         "roofline_per_candidate": rooflines,
         "gate": "bitwise-vs-reference",
+        "profile": profile,
         "runs": int(runs),
         "n_queries": int(queries.shape[0]),
         "margin": int(margin),

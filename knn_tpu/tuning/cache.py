@@ -92,8 +92,17 @@ def roofline_token() -> str:
         return "unknown"
 
 
+#: Tuning regimes a winner can be keyed under.  ``latency`` is the
+#: serving regime (small batches, time-to-first-result) and is the
+#: default everywhere; ``throughput`` is the bulk kNN-join regime
+#: (huge query superblocks, rows/s) whose grid reaches block_q values
+#: a latency tune would never time.  Separate key suffix = separate
+#: cache rows: a join winner can never clobber a serving winner.
+PROFILES = ("latency", "throughput")
+
+
 def cache_key(device_kind: str, n: int, d: int, k: int, metric: str,
-              dtype: Optional[str]) -> str:
+              dtype: Optional[str], profile: str = "latency") -> str:
     """The shape key a winner is valid for.  ``dtype`` is the placement
     compute dtype (None = float32, the library default); any field
     mismatch MUST miss — a winner tuned for one shape says nothing
@@ -101,11 +110,20 @@ def cache_key(device_kind: str, n: int, d: int, k: int, metric: str,
     the entry to the roofline-model schema its attribution was rendered
     under (:func:`roofline_token`) and the kernel code that was
     measured (:func:`kernel_version_token`); pre-token entries (no
-    ``|rl``/``|kv`` suffix) miss the same way."""
+    ``|rl``/``|kv`` suffix) miss the same way.  ``profile`` picks the
+    tuning regime (:data:`PROFILES`): the default ``latency`` key is
+    byte-identical to the pre-profile format (old caches keep
+    hitting), while ``throughput`` appends a ``|throughput`` suffix so
+    the two regimes' winners live in disjoint rows."""
+    if profile not in PROFILES:
+        raise ValueError(
+            f"unknown tuning profile {profile!r}; expected one of "
+            f"{PROFILES}")
+    suffix = "" if profile == "latency" else f"|{profile}"
     return (f"{device_kind}|n{int(n)}|d{int(d)}|k{int(k)}|"
             f"{metric.lower()}|{dtype or 'float32'}"
             f"|rl{roofline_token()}"
-            f"|kv{kernel_version_token()}")
+            f"|kv{kernel_version_token()}" + suffix)
 
 
 class TuneCache:

@@ -124,6 +124,7 @@ def run_lint(repo) -> int:
         for name, label in (("loadgen_knee", "knee"),
                             ("mutation", "mutation"),
                             ("ivf", "ivf"),
+                            ("join", "join"),
                             ("multihost", "multihost"),
                             ("sentinel", "sentinel verdict")):
             viol = sum(1 for p in problems if p["schema"] == name)

@@ -183,7 +183,8 @@ def test_version_tokens_resolve_and_are_owned_once():
                       "version": "loadgen_knee",
                       "mutation_version": "mutation",
                       "ivf_version": "ivf",
-                      "pq_version": "pq"}
+                      "pq_version": "pq",
+                      "join_version": "join"}
 
 
 def test_catalog_refuses_duplicate_version_tokens():
@@ -221,6 +222,7 @@ def test_sentinel_curated_fields_derived_in_legacy_order():
         ("recall_at_k", "higher"),
         ("ivf_qps", "higher"),
         ("bytes_streamed_ratio", "lower"),
+        ("join_rows_per_s", "higher"),
     )
 
 

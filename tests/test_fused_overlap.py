@@ -326,9 +326,11 @@ def test_roofline_v2_select_overlap_semantics():
     # overlay semantics); v4 = the multi-host DCN merge term
     # (tests/test_multihost.py/test_roofline.py own it); v5 = the IVF
     # probed-bytes term (tests/test_ivf.py owns it); v6 = the sub-int8
-    # compressed-tier widths (tests/test_roofline.py owns it); the
-    # select-overlap formulas above are pinned version-independently
-    assert roofline.MODEL_VERSION == 6
+    # compressed-tier widths (tests/test_roofline.py owns it); v7 = the
+    # bulk-join amortized db-bytes + h2d terms (tests/test_join.py owns
+    # it); the select-overlap formulas above are pinned
+    # version-independently
+    assert roofline.MODEL_VERSION == 7
     # a fused config whose carry would exceed MAX_CARRY_DEPTH disarms
     # in the kernel — the model mirrors the disarm and falls back to
     # the serialized ceiling, so pruning/--best can never hold other

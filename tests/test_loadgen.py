@@ -445,3 +445,70 @@ def test_sentinel_curates_mutation_admitted_p99():
               "mutation_admitted_p99_ms": 9.5}
     assert sentinel.verdict_for_line(better, baselines=baselines)[
         "fields"]["mutation_admitted_p99_ms"]["verdict"] == "ok"
+
+
+# -- offline bulk-join lane (bulk kNN-join satellite) ---------------------
+def test_bulk_mix_deterministic_and_shaped():
+    spec = WorkloadSpec(
+        rate_qps=400, duration_s=0.5, seed=11,
+        tenants=(TenantSpec("serve", weight=3, batch_sizes=(1, 2)),
+                 TenantSpec("joiner", weight=1, batch_sizes=(1,),
+                            bulk_fraction=0.6, bulk_rows=32)))
+    a, b = generate(spec), generate(spec)
+    assert a == b  # element for element, kinds included
+    n_bulk = sum(1 for r in a if r.kind == "bulk")
+    assert n_bulk > 0
+    assert all(r.rows == 32 for r in a if r.kind == "bulk")
+    assert all(r.kind == "query" for r in a if r.tenant == "serve")
+
+
+def test_bulk_free_schedule_unchanged_by_the_bulk_draw():
+    # the kind draw stays gated on MIXED tenants: adding bulk_fraction
+    # to the gate must not move the rng sequence of a pure-query spec
+    # (same pin as the write-free case — recorded traces keep replaying)
+    spec = WorkloadSpec(rate_qps=300, duration_s=0.4, seed=9,
+                        tenants=(TenantSpec("a", batch_sizes=(1, 4)),
+                                 TenantSpec("b", weight=2.0,
+                                            batch_sizes=(2,))))
+    got = generate(spec)
+    assert all(r.kind == "query" for r in got)
+    assert all(r.bulk_fraction == 0.0 for r in spec.tenants)
+
+
+def test_bulk_validation():
+    with pytest.raises(ValueError, match="fractions"):
+        TenantSpec("j", insert_fraction=0.5, delete_fraction=0.3,
+                   bulk_fraction=0.3).validate()
+    with pytest.raises(ValueError, match="fractions"):
+        TenantSpec("j", bulk_fraction=-0.1).validate()
+    with pytest.raises(ValueError, match="bulk_rows"):
+        TenantSpec("j", bulk_fraction=0.1, bulk_rows=0).validate()
+
+
+def test_driver_bulk_lane_has_own_section_and_reads_stay_clean():
+    """The mixed knee shape: bulk superblocks ride target.submit (the
+    same admission control as queries), but their outcomes + latencies
+    land in the report's ``bulk`` section — the interactive read-side
+    offered/percentiles cover queries ONLY."""
+    spec = WorkloadSpec(
+        rate_qps=500, duration_s=0.4, seed=4,
+        tenants=(TenantSpec("serve", weight=0.7, batch_sizes=(1,)),
+                 TenantSpec("joiner", weight=0.3, batch_sizes=(1,),
+                            bulk_fraction=0.8, bulk_rows=16)))
+    reqs = generate(spec)
+    n_bulk = sum(1 for r in reqs if r.kind == "bulk")
+    assert n_bulk > 0
+    with SyntheticTarget(2000.0) as tgt:
+        rep = run_workload(tgt, reqs, queries=POOL)
+    bulk = rep["bulk"]
+    assert bulk["total"] == sum(bulk["outcomes"].values()) == n_bulk
+    assert bulk["ok"] <= bulk["total"]
+    if bulk["ok"]:
+        assert bulk["latency_ms"]["count"] == bulk["ok"]
+    # read-side numbers cover queries only — no dilution either way
+    assert rep["offered"] == len(reqs) - n_bulk
+    assert rep["ok"] <= rep["offered"]
+    lat = rep["latency_ms"]
+    assert lat is None or lat["count"] <= rep["ok"]
+    # bulk never requires submit_write: a write-less target serves it
+    assert "writes" not in rep

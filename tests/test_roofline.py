@@ -162,7 +162,7 @@ def test_int4_streaming_breaks_the_int8_hbm_ceiling():
     hbm-bound operating point (small nq, block_q=8, SIFT1M on a v5e)
     both int8 and int4 streaming hit the HBM wall, and halving the
     streamed bytes lifts the modeled ceiling >= 1.8x."""
-    assert roofline.MODEL_VERSION == 6
+    assert roofline.MODEL_VERSION == 7
     kw = dict(n=1_000_000, d=128, k=10, nq=8, kernel="streaming",
               block_q=8, device_kind="TPU v5e", backend="tpu")
     m8 = roofline.pallas_cost_model(precision="int8", **kw)

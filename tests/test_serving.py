@@ -331,3 +331,34 @@ def test_warmup_prebuilds_int8_placement_when_winner_says_so(
     counts = engine.warmup()
     assert counts.get("int8_placement") == 1
     assert prog._int8_cache is not None
+
+
+# -- metric matrix through the serving surface (join-PR satellite) --------
+@pytest.mark.parametrize("metric", ["l1", "cosine", "dot"])
+def test_metric_matrix_bucketed_matches_direct_search(rng, metric):
+    """l1 / cosine / dot serve through search_bucketed with the same
+    neighbors and tie-break order as the direct search — the bucketed
+    exactness contract is metric-independent."""
+    db = (rng.random((300, DIM)) * 10).astype(np.float32)
+    q = (rng.random((11, DIM)) * 10).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5, metric=metric)
+    ref_d, ref_i = prog.search(q)
+    d, i = prog.search_bucketed(q, buckets=BUCKETS)
+    np.testing.assert_array_equal(i, np.asarray(ref_i))
+    np.testing.assert_allclose(d, np.asarray(ref_d), rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("metric", ["l1", "cosine", "dot"])
+def test_metric_matrix_serving_engine(rng, metric):
+    db = (rng.random((300, DIM)) * 10).astype(np.float32)
+    q = (rng.random((9, DIM)) * 10).astype(np.float32)
+    prog = ShardedKNN(db, mesh=make_mesh(4, 2), k=5, metric=metric)
+    eng = ServingEngine(prog, buckets=BUCKETS)
+    ref_d, ref_i = prog.search(q)
+    d, i = eng.search(q)
+    np.testing.assert_array_equal(i, np.asarray(ref_i))
+    np.testing.assert_allclose(d, np.asarray(ref_d), rtol=1e-5,
+                               atol=1e-6)
+    st = eng.stats(include_slo=False)
+    assert sum(st["per_bucket_dispatches"].values()) >= 1

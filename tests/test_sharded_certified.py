@@ -65,7 +65,7 @@ def test_predict_certified_matches_exact_predict(data):
 def test_certified_rejects_non_l2(data):
     db, queries = data
     prog = ShardedKNN(db, mesh=make_mesh(8, 1), k=3, metric="l1")
-    with pytest.raises(ValueError, match="l2 and cosine"):
+    with pytest.raises(ValueError, match="l2, cosine and dot"):
         prog.search_certified(queries)
 
 
@@ -266,7 +266,7 @@ def test_certified_cosine_plain_search_agrees(rng):
 def test_certified_l1_still_rejected(rng):
     db = rng.normal(size=(64, 8)).astype(np.float32)
     prog = ShardedKNN(db, mesh=make_mesh(1, 1), k=3, metric="l1")
-    with pytest.raises(ValueError, match="l2 and cosine"):
+    with pytest.raises(ValueError, match="l2, cosine and dot"):
         prog.search_certified(rng.normal(size=(2, 8)).astype(np.float32))
 
 

@@ -315,3 +315,68 @@ def test_int8_candidate_eligible_when_results_match(rng, cache_path):
                             cache_path=cache_path)
     assert entry["timings_ms"]["precision=int8"] is not None
     assert "precision=int8" not in entry["errors"]
+
+
+# -- the "throughput" grid profile (bulk kNN-join satellite) --------------
+def test_throughput_profile_grid_is_a_strict_superset():
+    """The throughput profile EXTENDS each level with the large-block_q
+    ladder; the latency grids (and therefore every existing winner)
+    are byte-identical to the pre-profile ones."""
+    for level in ("quick", "standard", "full"):
+        lat = tuning.knob_grid(level)
+        thr = tuning.knob_grid(level, profile="throughput")
+        assert lat == tuning.knob_grid(level, profile="latency")
+        assert len(thr) > len(lat)
+        for cand in lat:
+            assert cand in thr
+        # the extension IS the large-superblock ladder
+        assert any((c.get("block_q") or 0) >= 512 for c in thr), level
+        assert all((c.get("block_q") or 0) < 512 for c in lat), level
+    with pytest.raises(ValueError, match="profile"):
+        tuning.knob_grid("standard", profile="bulk")
+
+
+def test_throughput_grid_fits_the_vmem_budget_everywhere():
+    """No fits-nowhere arms: every throughput candidate places on at
+    least one known device kind under the VMEM budget model at the
+    headline shape — the same pricing check_vmem sweeps in CI."""
+    from knn_tpu.analysis import vmem
+
+    for knobs in tuning.knob_grid("full", profile="throughput"):
+        full = {**tuning.DEFAULT_KNOBS, **knobs}
+        assert vmem.fits_some_kind(full, **vmem.HEADLINE_SHAPE), knobs
+
+
+def test_profile_cache_keys_are_disjoint_and_latency_is_unchanged():
+    from knn_tpu.tuning.cache import cache_key
+
+    assert tuning.PROFILES == ("latency", "throughput")
+    base = cache_key("TPU v5e", 1_000_000, 128, 100, "l2", "bf16x3")
+    lat = cache_key("TPU v5e", 1_000_000, 128, 100, "l2", "bf16x3",
+                    profile="latency")
+    thr = cache_key("TPU v5e", 1_000_000, 128, 100, "l2", "bf16x3",
+                    profile="throughput")
+    assert lat == base  # old persisted winners keep hitting
+    assert thr == base + "|throughput"  # disjoint rows, never clobber
+    with pytest.raises(ValueError, match="profile"):
+        cache_key("TPU v5e", 1, 1, 1, "l2", None, profile="join")
+
+
+def test_autotune_throughput_profile_keys_its_own_row(data, cache_path):
+    db, q = data
+    grid = [dict(tuning.DEFAULT_KNOBS)]
+    entry = tuning.autotune(db, q, 5, margin=8, grid=grid, runs=1,
+                            cache_path=cache_path, profile="throughput")
+    assert entry["profile"] == "throughput"
+    raw = json.load(open(cache_path))
+    (key,) = raw["entries"]
+    assert key == tuning.cache_key("cpu", 700, 16, 5, "l2", None,
+                                   profile="throughput")
+    assert key.endswith("|throughput")
+    # a latency resolve for the same shape never sees the join winner
+    _, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path)
+    assert info["source"] == "default"
+    _, info = tuning.resolve_full(700, 16, 5, cache_path=cache_path,
+                                  profile="throughput")
+    assert info["source"] == "cache"
+    assert info["profile"] == "throughput"
