@@ -41,10 +41,14 @@ Env overrides:
   KNN_BENCH_CONFIG   sift1m (default) | glove | gist1m   (BASELINE configs 3/4/5)
   KNN_BENCH_MODES    comma list from {exact,certified_approx,
                      certified_pallas,serving,knee,multihost,mutation,
-                     ivf,join}; ``join`` is the opt-in bulk kNN-join
-                     line (knn_tpu.join: double-buffered superblock
-                     stream vs looped serving on the same placement;
-                     KNN_BENCH_JOIN_ROWS/_SUPERBLOCK/_DEPTH shape it)
+                     ivf,join,quality}; ``join`` is the opt-in bulk
+                     kNN-join line (knn_tpu.join: double-buffered
+                     superblock stream vs looped serving on the same
+                     placement; KNN_BENCH_JOIN_ROWS/_SUPERBLOCK/_DEPTH
+                     shape it); ``quality`` is the opt-in shadow-audit
+                     replay (knn_tpu.obs.audit at rate 1.0:
+                     KNN_BENCH_QUALITY_REQUESTS requests re-scored
+                     against the f64 exact oracle)
   KNN_BENCH_RUNS     timed repetitions per mode (default 5)
   KNN_BENCH_N, KNN_BENCH_DIM, KNN_BENCH_K, KNN_BENCH_NQ, KNN_BENCH_BATCH,
   KNN_BENCH_TILE, KNN_BENCH_CPU_QUERIES, KNN_BENCH_MARGIN,
@@ -222,6 +226,14 @@ try:
     JOIN_ROWS = _env_int("KNN_BENCH_JOIN_ROWS", 0)
     JOIN_SUPERBLOCK = _env_int("KNN_BENCH_JOIN_SUPERBLOCK", 0)
     JOIN_DEPTH = _env_int("KNN_BENCH_JOIN_DEPTH", 2)
+
+    #: ``quality`` mode (knn_tpu.obs.audit): a short serving replay
+    #: with the shadow audit sampler forced to rate 1.0, so EVERY
+    #: request's served top-k is re-scored against the f64 exact
+    #: oracle on the audit worker thread.  Opt-in via
+    #: KNN_BENCH_MODES=..,quality; each request pays one host-side
+    #: oracle scan over the full corpus, so the count stays small.
+    QUALITY_REQUESTS = _env_int("KNN_BENCH_QUALITY_REQUESTS", 8)
 except Exception as _e:  # bad env: the one-JSON-line contract still holds
     print(json.dumps({
         "metric": "knn_qps_config", "value": None, "unit": "queries/s",
@@ -1294,6 +1306,84 @@ def main() -> None:
 
         return JOIN_VERSION
 
+    def sweep_quality():
+        """Opt-in shadow-audit quality measurement (knn_tpu.obs.audit):
+        a short serving replay with the audit sampler forced to rate
+        1.0, so every request's served top-k is re-scored off the
+        serving path against the f64 exact oracle over the full placed
+        corpus.  The block is the audited quality ledger — recall@k,
+        rank displacement, distance error — as one validated
+        ``quality`` artifact block; audit_recall_at_k hoists to the
+        line via the schema catalog."""
+        from knn_tpu import obs as _obs
+        from knn_tpu.obs import audit as _audit
+        from knn_tpu.obs import names as _names
+        from knn_tpu.serving.engine import ServingEngine
+
+        if not _obs.enabled():
+            return {"quality": {
+                "error": "telemetry disabled (KNN_TPU_OBS=0): the "
+                         "audit sampler cannot arm"}}
+        saved = {k: os.environ.get(k)
+                 for k in (_audit.AUDIT_RATE_ENV,
+                           _audit.AUDIT_BUDGET_ENV)}
+        os.environ[_audit.AUDIT_RATE_ENV] = "1.0"
+        os.environ.pop(_audit.AUDIT_BUDGET_ENV, None)
+        _audit.reset_auditor()
+        t0 = time.perf_counter()
+        try:
+            min_bucket = SERVING_MIN_BUCKET or max(1, BATCH // 32)
+            eng = ServingEngine(prog, min_bucket=min_bucket,
+                                max_bucket=BATCH)
+            eng.warmup()
+            rng_q = np.random.default_rng(1234)
+            handles = []
+            for _ in range(QUALITY_REQUESTS):
+                s = int(rng_q.integers(1, BATCH + 1))
+                lo = int(rng_q.integers(0, max(1, NQ - s)))
+                handles.append(eng.submit(queries[lo:lo + s]))
+            for h in handles:
+                h.result()
+            aud = _audit.get_auditor()
+            drained = aud.drain(timeout=120.0)
+            summ = aud.summary()
+            disp = _obs.histogram(_names.AUDIT_RANK_DISPLACEMENT,
+                                  tenant="-").summary()
+            derr = _obs.histogram(_names.AUDIT_DISTANCE_ERROR,
+                                  tenant="-").summary()
+            recall = _obs.histogram(_names.AUDIT_RECALL,
+                                    tenant="-").summary()
+            block = {
+                "quality_version": _audit.QUALITY_VERSION,
+                "audit_rate": summ["rate"],
+                "audit_sampled_requests": summ["sampled_requests"],
+                "audit_replayed_queries": summ["replayed_queries"],
+                "audit_deficient_queries": summ["deficient_queries"],
+                "audit_dropped_records":
+                    int(sum(summ["dropped"].values())),
+                "audit_recall_at_k":
+                    (round(float(recall["mean"]), 6)
+                     if recall.get("window") else None),
+                "audit_rank_displacement_p99":
+                    (round(float(disp["p99"]), 4)
+                     if disp.get("window") else None),
+                "audit_distance_rel_error_p99":
+                    (round(float(derr["p99"]), 8)
+                     if derr.get("window") else None),
+                "wall_s": round(time.perf_counter() - t0, 4),
+            }
+            if not drained:
+                block["error"] = ("audit drain timed out with "
+                                  "replays still pending")
+            return {"quality": block}
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            _audit.reset_auditor()
+
     def roofline_for_mode(mode, entry):
         """The selector's ``roofline`` block (knn_tpu.obs.roofline):
         analytic ceiling q/s + bound class for the config this mode
@@ -1647,6 +1737,15 @@ def main() -> None:
                 entry = {"error": f"{type(e).__name__}: {e}"}
             results[mode] = entry
             continue
+        if mode == "quality":
+            # shadow-audit quality replay: a correctness ledger, never
+            # a throughput competitor
+            try:
+                entry = sweep_quality()
+            except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+                entry = {"error": f"{type(e).__name__}: {e}"}
+            results[mode] = entry
+            continue
         try:
             fn = sweeps[mode]
             _vlog(f"mode {mode}: recall check + warm ...")
@@ -1905,6 +2004,10 @@ def main() -> None:
         # the line; rows_per_s hoists below as join_rows_per_s
         **({"join": results["join"]["join"]}
            if results.get("join", {}).get("join") else {}),
+        # the shadow-audit quality ledger (opt-in quality mode): block
+        # on the line; audit_recall_at_k hoists via the catalog loop
+        **({"quality": results["quality"]["quality"]}
+           if results.get("quality", {}).get("quality") else {}),
         **(gate or {}),
         "recall_at_k": results[best].get("recall_at_k"),
         **recall_flag,

@@ -871,6 +871,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("ivf", "any"),
             Field("pq", "any"),
             Field("join", "any"),
+            Field("quality", "any"),
             Field("multihost", "any"),
             Field("campaign", "any"),
             Field("sentinel", "any"),
@@ -885,6 +886,7 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("ivf_qps", "number", nullable=True),
             Field("bytes_streamed_ratio", "number", nullable=True),
             Field("join_rows_per_s", "number", nullable=True),
+            Field("audit_recall_at_k", "number", nullable=True),
             Field("multihost_hosts", "int", nullable=True),
             Field("multihost_merge", "str", nullable=True),
             Field("multihost_qps", "number", nullable=True),
@@ -1513,6 +1515,69 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("plan", "any"),
             Field("fallback_queries", "any"),
             Field("validation_errors", "any"),
+            Field("error", "any"),
+        ),
+    ),
+    # --- quality (shadow audit) ------------------------------------------
+    BlockSchema(
+        name="quality",
+        block_path="quality",
+        doc="docs/OBSERVABILITY.md#Quality observability",
+        emitters=("bench.py",),
+        fingerprints=(frozenset({"quality_version",
+                                 "audit_recall_at_k"}),),
+        version_field="quality_version",
+        version_ref=Ref("knn_tpu.obs.audit", "QUALITY_VERSION"),
+        version_exact=True,
+        not_dict_legacy="quality block must be a dict, got {vtype}",
+        error_exempt="validator",
+        refusal_label="quality",
+        curate=True,
+        sweep=True,
+        missing_order=("quality_version", "audit_rate",
+                       "audit_sampled_requests",
+                       "audit_replayed_queries",
+                       "audit_deficient_queries",
+                       "audit_dropped_records", "audit_recall_at_k"),
+        missing_legacy="missing {key!r}",
+        hoists=(Hoist("audit_recall_at_k", "audit_recall_at_k"),),
+        # the quality headline the sentinel baselines: shadow-audited
+        # recall@k against the f64 exact oracle, higher is better —
+        # the number the whole audit pipeline exists to watch
+        curated=(Curated("audit_recall_at_k", "higher", 13),),
+        checks=(
+            Field("quality_version", "version", required=True,
+                  legacy="quality_version must be {version}, got "
+                         "{value!r}"),
+            Field("audit_rate", "number", required=True, ge=0, le=1,
+                  legacy="audit_rate must be a number in [0, 1], got "
+                         "{value!r}"),
+            Field("audit_sampled_requests", "int", required=True,
+                  ge=0,
+                  legacy="{path} must be a non-negative int, got "
+                         "{value!r}"),
+            Field("audit_replayed_queries", "int", required=True,
+                  ge=0,
+                  legacy="{path} must be a non-negative int, got "
+                         "{value!r}"),
+            Field("audit_deficient_queries", "int", required=True,
+                  ge=0,
+                  legacy="{path} must be a non-negative int, got "
+                         "{value!r}"),
+            Field("audit_dropped_records", "int", required=True, ge=0,
+                  legacy="{path} must be a non-negative int, got "
+                         "{value!r}"),
+            # null until the first replay lands (all sampled records
+            # still queued or dropped)
+            Field("audit_recall_at_k", "number", required=True,
+                  nullable=True, ge=0, le=1,
+                  legacy="audit_recall_at_k must be a number in "
+                         "[0, 1] or null, got {value!r}"),
+            Field("audit_rank_displacement_p99", "number",
+                  nullable=True),
+            Field("audit_distance_rel_error_p99", "number",
+                  nullable=True),
+            Field("wall_s", "any"),
             Field("error", "any"),
         ),
     ),

@@ -92,6 +92,20 @@ SWITCHES: Tuple[Switch, ...] = (
        "edge-triggered SLO breach."),
     _s("KNN_TPU_POSTMORTEM_KEEP", "int", "knn_tpu/obs/blackbox.py", _OBS,
        "Postmortem bundle retention cap (default 8)."),
+    _s("KNN_TPU_OBS_EXEMPLAR_CAP", "int", "knn_tpu/obs/registry.py",
+       _OBS, "Worst-recent exemplars retained per histogram series "
+       "(default 8; 0 disables retention)."),
+    _s("KNN_TPU_OBS_EXEMPLAR_AGE_S", "float", "knn_tpu/obs/registry.py",
+       _OBS, "Exemplar age-out horizon in seconds (default 600)."),
+    # --- shadow audit sampler (knn_tpu.obs.audit) ----------------------
+    _s("KNN_TPU_AUDIT_RATE", "float", "knn_tpu/obs/audit.py", _OBS,
+       "Fraction of live requests the shadow audit sampler replays "
+       "against the f64 exact oracle, selected deterministically by "
+       "trace-id hash (unset/0 = off; KNN_TPU_OBS=0 pins it off)."),
+    _s("KNN_TPU_AUDIT_BUDGET_ROWS_S", "float", "knn_tpu/obs/audit.py",
+       _OBS, "Hard oracle row budget for audit replays (rows/second "
+       "token bucket, default 5e6); over-budget records are dropped "
+       "and counted."),
     # --- measured-term calibration (knn_tpu.obs.calibrate) -------------
     _s("KNN_TPU_CALIBRATION", "path", "knn_tpu/obs/calibrate.py", _OBS,
        "Calibration store JSON: per-term roofline scale factors "
@@ -360,6 +374,10 @@ SWITCHES: Tuple[Switch, ...] = (
        "resolution ladder)."),
     _s("KNN_BENCH_JOIN_DEPTH", "int", "bench.py", _PERF,
        "Dispatch-ahead depth of the join sweep (default 2)."),
+    # --- bench.py: shadow-audit replay (opt-in quality mode) -----------
+    _s("KNN_BENCH_QUALITY_REQUESTS", "int", "bench.py", _PERF,
+       "Serving requests of the opt-in quality mode's shadow-audit "
+       "replay (default 8; each pays one full f64 oracle scan)."),
 )
 
 #: name -> Switch for exact lookups

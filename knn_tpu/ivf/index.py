@@ -213,6 +213,9 @@ class IVFIndex:
         self._snap_cache: Optional[_IVFSnapshot] = None
         self._train_base(base, ids_arr)
         self._live = set(ids_arr.tolist())
+        # health/statusz registration (weak; no-op when obs disabled):
+        # surfaces epoch/tail/tombstone state and the drift sketches
+        obs.health.register_index(self)
 
     # -- placement ---------------------------------------------------------
     def _train_base(self, base: np.ndarray, base_ids: np.ndarray) -> None:
@@ -237,6 +240,18 @@ class IVFIndex:
             perm[starts[l]:starts[l + 1]]
             for l in range(self.ncentroids))
         self._base_counts = km.counts.copy()
+        # train-time drift baseline (knn_tpu.obs.drift): built ONLY
+        # when telemetry is on — KNN_TPU_OBS=0 means no sketches at
+        # all, the pinned obs-off contract
+        self._drift = None
+        if obs.enabled():
+            from knn_tpu.obs.drift import QueryDriftMonitor
+
+            norms = np.sqrt(np.einsum(
+                "nd,nd->n", base.astype(np.float64),
+                base.astype(np.float64)))
+            self._drift = QueryDriftMonitor(
+                train_norms=norms, assign_baseline=km.counts)
 
     def _assign_host(self, rows: np.ndarray) -> np.ndarray:
         """Nearest-centroid assignment for delta-tail rows, host f64
@@ -315,10 +330,12 @@ class IVFIndex:
 
     # -- search ------------------------------------------------------------
     def _probe(self, q64: np.ndarray, snap: _IVFSnapshot, nprobe: int):
-        """(probes [Q, P] sorted list ids, unprobed_lb [Q] f64): the
-        probe pick plus each query's lower bound over every UNPROBED
-        non-empty list — ``min_l (||q - c_l|| - r_l)`` — computed in
-        f64 with the direct-difference form (no cancellation)."""
+        """(probes [Q, P] sorted list ids, unprobed_lb [Q] f64,
+        nearest [Q] int64): the probe pick, each query's lower bound
+        over every UNPROBED non-empty list — ``min_l (||q - c_l|| -
+        r_l)`` — computed in f64 with the direct-difference form (no
+        cancellation), and the nearest centroid (the drift sketch's
+        assignment stream)."""
         n_q = q64.shape[0]
         c = snap.ncentroids
         cd = np.empty((n_q, c))
@@ -331,7 +348,7 @@ class IVFIndex:
         lb = cd - snap.residuals[None, :]
         np.put_along_axis(lb, order[:, :nprobe], np.inf, axis=-1)
         lb[:, snap.list_sizes == 0] = np.inf
-        return probes, lb.min(axis=-1)
+        return probes, lb.min(axis=-1), order[:, 0]
 
     def _coarse_counted(self, q_grp: np.ndarray, pos: np.ndarray,
                         snap: _IVFSnapshot, kk: int, m: int):
@@ -443,8 +460,12 @@ class IVFIndex:
         nprobe_r = max(1, min(nprobe_r, snap.ncentroids))
         n_q = q.shape[0]
         t0 = time.perf_counter()
-        probes, unprobed_lb = self._probe(q.astype(np.float64), snap,
-                                          nprobe_r)
+        q64 = q.astype(np.float64)
+        probes, unprobed_lb, nearest = self._probe(q64, snap, nprobe_r)
+        if self._drift is not None:
+            self._drift.observe(
+                norms=np.sqrt(np.einsum("qd,qd->q", q64, q64)),
+                assignments=nearest)
         d_out = np.full((n_q, k), np.inf)
         pos_out = np.full((n_q, k), snap.n_all, np.int64)
         flagged = np.zeros(n_q, bool)
@@ -458,6 +479,10 @@ class IVFIndex:
         groups: dict = {}
         for qi in range(n_q):
             groups.setdefault(tuple(probes[qi].tolist()), []).append(qi)
+        # certificate-margin telemetry: how close each probed answer
+        # came to the unprobed-list bound (1.0 = miles of headroom,
+        # ~0 = one insert away from fallback, < 0 = the bound failed)
+        margins: list = [] if obs.enabled() else None
         for key, members in groups.items():
             qi = np.asarray(members, np.int64)
             pos = snap.positions_for(key)
@@ -475,8 +500,18 @@ class IVFIndex:
             d_out[qi] = d_ref
             pos_out[qi] = p_ref
             s_k = np.sqrt(d_ref[:, k - 1])
-            bound_ok = s_k < unprobed_lb[qi] * (1.0 - _BOUND_SLACK)
+            lb = unprobed_lb[qi]
+            bound_ok = s_k < lb * (1.0 - _BOUND_SLACK)
             flagged[qi] = ~(complete & bound_ok)
+            if margins is not None:
+                fin = np.isfinite(lb)
+                if fin.any():
+                    margins.extend(
+                        ((lb[fin] - s_k[fin])
+                         / np.maximum(np.abs(lb[fin]), 1e-30)).tolist())
+        if margins:
+            obs.histogram(obs.names.CERTIFIED_MARGIN,
+                          path="ivf").observe_many(margins)
         n_bad = int(flagged.sum())
         misses = 0
         recall_sum = float(n_q - n_bad)  # certified queries: exactly 1.0
@@ -533,6 +568,22 @@ class IVFIndex:
                                      if brute_b else 0.0),
             "wall_s": round(wall, 6),
         }
+        if obs.enabled():
+            # the per-search quality stats, as scrapable gauges beside
+            # the dict the caller gets (satellite: registry export)
+            for name, key in (
+                (obs.names.IVF_FALLBACK_RATE, "fallback_rate"),
+                (obs.names.IVF_RECALL_AT_K, "recall_at_k"),
+                (obs.names.IVF_PROBE_FRACTION, "probe_fraction"),
+                (obs.names.IVF_BYTES_STREAMED_RATIO,
+                 "bytes_streamed_ratio"),
+            ):
+                obs.gauge(name, selector=selector).set(stats[key])
+            from knn_tpu.obs.drift import index_health
+
+            index_health(snap.list_sizes,
+                         int(snap.tail_assign.shape[0]),
+                         snap.n_all, snap.n_live)
         with self._lock:
             self._last_search = stats
         return stats
@@ -740,6 +791,8 @@ class IVFIndex:
                    if self._last_compaction else {}),
                 **({"last_search": dict(self._last_search)}
                    if self._last_search else {}),
+                **({"drift": self._drift.status()}
+                   if self._drift is not None else {}),
             }
             return out
 
@@ -803,11 +856,71 @@ class IVFServingEngine:
                 f"queries shape {q.shape} incompatible with database "
                 f"dim {self._dim}")
         tid = trace_id if trace_id is not None else f"ivf-{next(self._seq)}"
+        # shadow audit sampling (knn_tpu.obs.audit): pin the snapshot
+        # BEFORE the search so the replay judges the served answer
+        # against the exact corpus state it was served from
+        audit_q = q.copy() if obs.audit.sampled(tid) else None
+        snap = self.index._snapshot() if audit_q is not None else None
         t0 = time.perf_counter()
         d, ids, _stats = self.index.search_certified(q, k=self.k)
         obs.record_span("serving.request", tid,
                         time.perf_counter() - t0, op="ivf_search")
+        if audit_q is not None:
+            self._submit_audit(tid, tenant, audit_q, d, ids, snap,
+                               _stats.get("epoch"))
         return _IVFPending(tid, tenant, (d, ids))
+
+    def _submit_audit(self, tid, tenant, q_audit, d, ids,
+                      snap, search_epoch) -> None:
+        """Enqueue one sampled, already-served request for off-path
+        exact replay (knn_tpu.obs.audit).  The oracle closure scans
+        every live row of the pinned snapshot in f64 — ONLY on the
+        audit worker thread.  Failure-proof: the request was served;
+        a broken audit layer degrades to a dropped record."""
+        try:
+            if search_epoch != snap.epoch:
+                # a compaction swapped between the snapshot pin and the
+                # search: the evidence is unjudgeable — drop it loudly
+                obs.counter(obs.names.AUDIT_DROPPED,
+                            reason="epoch_moved").inc()
+                return
+            k = self.k
+
+            def oracle(queries, served_ids):
+                from knn_tpu.ops.refine import (
+                    _pairwise_f64,
+                    refine_shared_exact,
+                )
+
+                od, o_pos = refine_shared_exact(
+                    snap.all_rows, queries, snap.live_positions, k)
+                oi = snap.all_ids[np.clip(o_pos, 0, snap.n_all - 1)]
+                order = np.argsort(snap.all_ids, kind="stable")
+                sorted_ids = snap.all_ids[order]
+                sid = np.asarray(served_ids, np.int64)[:, :k]
+                j = np.clip(np.searchsorted(sorted_ids, sid), 0,
+                            sorted_ids.shape[0] - 1)
+                pos = order[j]
+                valid = (sorted_ids[j] == sid) & snap.live_mask[pos]
+                se = _pairwise_f64(
+                    queries, snap.all_rows[np.where(valid, pos, 0)],
+                    "l2")
+                return od, oi, np.where(valid, se, np.inf)
+
+            obs.audit.submit(obs.audit.AuditRecord(
+                trace_id=tid,
+                tenant=tenant,
+                k=k,
+                queries=q_audit,
+                served_d=np.asarray(d),
+                served_ids=np.asarray(ids),
+                epoch=int(snap.epoch),
+                cost_rows=int(q_audit.shape[0]) * int(snap.n_live),
+                oracle=oracle,
+            ))
+        except Exception:  # noqa: BLE001 - audit must not fail serving
+            obs.emit_event("audit.submit_error", op="ivf_search",
+                           trace_id=tid)
 
     def search(self, queries, *, return_sqrt: bool = False):
         d, ids = self.submit(queries).result()

@@ -33,6 +33,14 @@ writes through here instead of keeping private ad-hoc counters:
 - **Flight recorder** (:mod:`knn_tpu.obs.blackbox`): one atomic,
   retention-capped postmortem bundle per edge-triggered SLO breach
   (``KNN_TPU_POSTMORTEM_DIR``), readable offline by ``cli waterfall``.
+- **Shadow audit sampler** (:mod:`knn_tpu.obs.audit`): off-path exact
+  replay of a deterministic sample of served requests against the f64
+  oracle (``KNN_TPU_AUDIT_RATE``), emitting per-tenant recall@k,
+  rank-displacement, and distance-error telemetry under a hard row
+  budget.
+- **Drift detection** (:mod:`knn_tpu.obs.drift`): streaming query
+  distribution sketches (norms, centroid assignments) scored by PSI
+  against train-time baselines, plus index-health gauges.
 
 The package itself imports no JAX (jax_hooks defers it), so the CLI's
 flag parsing and the lint script stay import-light.
@@ -42,7 +50,9 @@ Metric catalog, span lifecycle, and overhead numbers:
 """
 
 from knn_tpu.obs import (  # noqa: F401
+    audit,
     blackbox,
+    drift,
     health,
     names,
     profiler,
@@ -92,8 +102,8 @@ from knn_tpu.obs.trace import (  # noqa: F401
 
 __all__ = [
     "NOOP", "Counter", "EventLog", "Gauge", "Histogram",
-    "MetricsRegistry", "Objective", "SLOEngine", "blackbox",
-    "compact_snapshot",
+    "MetricsRegistry", "Objective", "SLOEngine", "audit", "blackbox",
+    "compact_snapshot", "drift",
     "counter", "emit_event", "enabled", "gauge", "get_event_log",
     "get_registry", "get_slo_engine", "health", "histogram",
     "install_compile_hook", "load_objectives", "names", "new_trace_id",

@@ -122,6 +122,17 @@ def on_breach(objective: str, detail: dict,
         _busy.v = False
 
 
+def _audit_evidence() -> Optional[dict]:
+    """The audit sampler's evidence section, failure-proof: a broken
+    audit layer must not take the flight recorder down with it."""
+    try:
+        from knn_tpu.obs import audit
+
+        return audit.get_auditor().evidence()
+    except Exception as e:  # noqa: BLE001 — recorder must never raise
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _write_bundle(objective: str, detail: dict,
                   slo_report: Optional[dict]) -> str:
     global _seq
@@ -154,6 +165,11 @@ def _write_bundle(objective: str, detail: dict,
         "env": {k: v for k, v in sorted(os.environ.items())
                 if k.startswith(("KNN_TPU_", "KNN_BENCH_",
                                  "JAX_PLATFORMS"))},
+        # the shadow audit sampler's evidence: summary + the bounded
+        # ring of failing audit records — for a quality-SLO breach
+        # this IS the postmortem (which requests served wrong answers,
+        # vs what the oracle says)
+        "audit": _audit_evidence(),
     }
     # measured-term calibration state: the statusz report already
     # carries the section (health's failure-proof probe) — hoist it

@@ -248,6 +248,33 @@ def _postmortems() -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _quality_status() -> dict:
+    """The shadow audit sampler's quality section (knn_tpu.obs.audit)
+    plus drift sketches from every registered IVF index — never fatal,
+    and never ARMS anything: a disabled sampler reports itself
+    disabled without starting a worker."""
+    try:
+        from knn_tpu.obs import audit
+
+        out = audit.status()
+        with _lock:
+            indexes = [i for i in (r() for r in _indexes)
+                       if i is not None]
+        drifts = []
+        for idx in indexes:
+            mon = getattr(idx, "_drift", None)
+            if mon is not None:
+                try:
+                    drifts.append(mon.status())
+                except Exception as e:  # noqa: BLE001
+                    drifts.append({"error": f"{type(e).__name__}: {e}"})
+        if drifts:
+            out["drift"] = drifts
+        return out
+    except Exception as e:  # noqa: BLE001 - introspection must not raise
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _calibration_status() -> dict:
     """The measured-term calibration store's state (worst per-term
     residual included) — never fatal: a broken store must not take the
@@ -314,6 +341,10 @@ def report(slo_section: Optional[dict] = None,
         # epoch / delta-tail / tombstone / compaction state — the
         # write-path health beside the read-path numbers above
         "index": _index_status(),
+        # quality observability: the shadow audit sampler's state
+        # (sampled/replayed/deficient/dropped) and any registered
+        # index's drift sketches (knn_tpu.obs.{audit,drift})
+        "quality": _quality_status(),
     }
 
 
@@ -365,7 +396,7 @@ def report_from_snapshot(payload: dict) -> dict:
                     "reason": "not recorded in this snapshot"},
         "engines": [], "queues": [],
         "tune_cache": {}, "roofline": {}, "calibration": {}, "slo": {},
-        "multihost": None, "index": [],
+        "multihost": None, "index": [], "quality": {},
         "active_breaches": [], "alerts": [],
         "slowest_requests": [], "postmortems": {},
     }
@@ -458,6 +489,24 @@ def render_text(rep: dict) -> str:
             f"compactions={ix.get('compactions')}"
             + (f" (last swap {lc.get('swap_s')}s)" if lc else "")
             + (" compactor=up" if ix.get("compactor_alive") else ""))
+    qual = rep.get("quality") or {}
+    if qual.get("enabled"):
+        dropped = qual.get("dropped") or {}
+        drop_s = (f" dropped={dropped}" if dropped else "")
+        lines.append(
+            f"quality: audit rate={qual.get('rate')} "
+            f"sampled={qual.get('sampled_requests')} "
+            f"replayed={qual.get('replayed_queries')}q "
+            f"deficient={qual.get('deficient_queries')} "
+            f"last_recall@k={qual.get('last_recall_at_k')}{drop_s}")
+    elif qual and "error" not in qual:
+        lines.append("quality: audit sampler off "
+                     "(KNN_TPU_AUDIT_RATE unset)")
+    for i, dr in enumerate(qual.get("drift") or []):
+        lines.append(
+            f"drift[{i}]: queries={dr.get('queries_observed')} "
+            f"norm_psi={dr.get('norm_psi')} "
+            f"assign_psi={dr.get('centroid_assign_psi')}")
     mh = rep.get("multihost")
     if mh:
         walls = mh.get("host_walls_s") or []

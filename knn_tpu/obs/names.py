@@ -121,6 +121,35 @@ INDEX_TOMBSTONES = "knn_tpu_index_tombstones"
 INDEX_COMPACTIONS = "knn_tpu_index_compactions_total"
 INDEX_SWAP_SECONDS = "knn_tpu_index_swap_seconds"
 
+# --- shadow audit sampler (knn_tpu.obs.audit) --------------------------
+AUDIT_SAMPLED = "knn_tpu_audit_sampled_requests_total"
+AUDIT_REPLAYED = "knn_tpu_audit_replayed_queries_total"
+AUDIT_DEFICIENT = "knn_tpu_audit_deficient_queries_total"
+AUDIT_DROPPED = "knn_tpu_audit_dropped_total"
+AUDIT_ROWS_SCORED = "knn_tpu_audit_rows_scored_total"
+AUDIT_RECALL = "knn_tpu_audit_recall_at_k"
+AUDIT_RANK_DISPLACEMENT = "knn_tpu_audit_rank_displacement"
+AUDIT_DISTANCE_ERROR = "knn_tpu_audit_distance_rel_error"
+
+# --- certificate-margin telemetry (sharded / ivf certified paths) ------
+CERTIFIED_MARGIN = "knn_tpu_certified_margin_ratio"
+
+# --- IVF per-search quality (knn_tpu.ivf.index) ------------------------
+IVF_FALLBACK_RATE = "knn_tpu_ivf_fallback_rate"
+IVF_RECALL_AT_K = "knn_tpu_ivf_recall_at_k"
+IVF_PROBE_FRACTION = "knn_tpu_ivf_probe_fraction"
+IVF_BYTES_STREAMED_RATIO = "knn_tpu_ivf_bytes_streamed_ratio"
+
+# --- query-distribution drift (knn_tpu.obs.drift) ----------------------
+DRIFT_NORM_PSI = "knn_tpu_drift_query_norm_psi"
+DRIFT_ASSIGN_PSI = "knn_tpu_drift_centroid_assign_psi"
+DRIFT_QUERIES = "knn_tpu_drift_queries_observed_total"
+
+# --- index-health gauges (knn_tpu.obs.drift) ---------------------------
+INDEX_LIST_IMBALANCE = "knn_tpu_index_list_imbalance"
+INDEX_TAIL_FRACTION = "knn_tpu_index_delta_tail_fraction"
+INDEX_TOMBSTONE_DENSITY = "knn_tpu_index_tombstone_density"
+
 #: name -> (type, label names, help).  Types: "counter" (monotone,
 #: float-valued so second-counters work), "gauge", "histogram" (bounded
 #: sample window + lifetime count/sum; exported as a Prometheus summary).
@@ -389,4 +418,92 @@ CATALOG = {
         "Seconds the compaction's atomic pointer swap held the index "
         "lock — the only slice of a compaction that can contend with "
         "the serving path (the build/warm runs off it)."),
+    AUDIT_SAMPLED: (
+        "counter", ("tenant",),
+        "Live requests selected by the shadow audit sampler's "
+        "trace-id hash (KNN_TPU_AUDIT_RATE), by tenant ('-' for "
+        "untagged traffic) — includes records later dropped by the "
+        "budget or backlog."),
+    AUDIT_REPLAYED: (
+        "counter", ("tenant",),
+        "Query rows replayed against the f64 exact oracle by the "
+        "audit worker, by tenant — the denominator of the "
+        "audit_recall SLO objective."),
+    AUDIT_DEFICIENT: (
+        "counter", ("tenant",),
+        "Audited query rows whose served neighbors missed the exact "
+        "top-k (recall@k < 1), by tenant — the numerator of the "
+        "audit_recall SLO objective."),
+    AUDIT_DROPPED: (
+        "counter", ("reason",),
+        "Sampled audit records dropped WITHOUT replay, by reason "
+        "(budget: over the KNN_TPU_AUDIT_BUDGET_ROWS_S token bucket; "
+        "queue_full: the bounded replay backlog; error: the oracle "
+        "replay raised) — a silent drop would read as a healthy "
+        "audit."),
+    AUDIT_ROWS_SCORED: (
+        "counter", (),
+        "Oracle rows scanned by completed audit replays — the spend "
+        "the row budget meters."),
+    AUDIT_RECALL: (
+        "histogram", ("tenant",),
+        "Per-audited-query recall@k of the served answer against the "
+        "f64 exact oracle (1.0 = the exact set, tie-tolerant), by "
+        "tenant."),
+    AUDIT_RANK_DISPLACEMENT: (
+        "histogram", ("tenant",),
+        "Per-served-neighbor displacement from its exact oracle rank "
+        "(0 = served in its true position), by tenant."),
+    AUDIT_DISTANCE_ERROR: (
+        "histogram", ("tenant",),
+        "Relative error of each served distance against its own f64 "
+        "recompute — arithmetic drift, independent of ranking."),
+    CERTIFIED_MARGIN: (
+        "histogram", ("path",),
+        "Per-certified-query relative margin between the k-th result "
+        "distance and the exclusion bound that certified it, by "
+        "certification path (sharded / ivf).  Margins crowding 0 are "
+        "the leading indicator that fallback rate is about to grow."),
+    IVF_FALLBACK_RATE: (
+        "gauge", ("selector",),
+        "Fraction of the last IVF search's queries that failed the "
+        "probe-pruning certificate and fell back to wider scans."),
+    IVF_RECALL_AT_K: (
+        "gauge", ("selector",),
+        "Measured recall@k of the last IVF search against its own "
+        "exact rescore (1.0 when every certificate held)."),
+    IVF_PROBE_FRACTION: (
+        "gauge", ("selector",),
+        "Fraction of trained IVF lists probed by the last search — "
+        "the pruning the tier exists to deliver."),
+    IVF_BYTES_STREAMED_RATIO: (
+        "gauge", ("selector",),
+        "Bytes streamed by the last IVF search as a fraction of the "
+        "brute-force full-corpus stream."),
+    DRIFT_NORM_PSI: (
+        "gauge", (),
+        "Population-stability index of the live query-norm histogram "
+        "against the train-time baseline (0 = identical; > 0.2 "
+        "investigate, > 0.5 act)."),
+    DRIFT_ASSIGN_PSI: (
+        "gauge", (),
+        "Population-stability index of the live IVF "
+        "centroid-assignment histogram against the k-means training "
+        "assignment counts."),
+    DRIFT_QUERIES: (
+        "counter", (),
+        "Query rows folded into the drift sketches."),
+    INDEX_LIST_IMBALANCE: (
+        "gauge", (),
+        "Max/mean trained IVF list size of the current snapshot "
+        "(1.0 = perfectly balanced; growth concentrates probe cost)."),
+    INDEX_TAIL_FRACTION: (
+        "gauge", (),
+        "Fraction of all index rows sitting in the unindexed delta "
+        "tail — the slice every search brute-forces until "
+        "compaction."),
+    INDEX_TOMBSTONE_DENSITY: (
+        "gauge", (),
+        "Fraction of all index rows tombstoned — dead bytes diluting "
+        "every stream until compaction drops them."),
 }

@@ -54,6 +54,48 @@ EXEMPLAR_CAP = 8
 #: yesterday's spike must not pin today's slowest-requests table
 EXEMPLAR_MAX_AGE_S = 600.0
 
+#: env overrides for the two retention knobs above (a forensics-heavy
+#: deployment keeps more/longer, a memory-tight one less) — re-resolved
+#: by :func:`reset`, so tests see their monkeypatched values
+EXEMPLAR_CAP_ENV = "KNN_TPU_OBS_EXEMPLAR_CAP"
+EXEMPLAR_AGE_ENV = "KNN_TPU_OBS_EXEMPLAR_AGE_S"
+
+
+def _resolve_exemplar_knobs() -> None:
+    """Resolve the exemplar retention knobs from the environment (the
+    module constants are the defaults).  Malformed values raise — a
+    typo'd retention knob must not silently fall back."""
+    global _exemplar_cap, _exemplar_age_s
+    raw = os.environ.get(EXEMPLAR_CAP_ENV)
+    if raw:
+        try:
+            cap = int(raw)
+        except ValueError:
+            cap = -1
+        if cap < 0:
+            raise ValueError(
+                f"{EXEMPLAR_CAP_ENV}={raw!r} is not a non-negative int")
+        _exemplar_cap = cap
+    else:
+        _exemplar_cap = EXEMPLAR_CAP
+    raw = os.environ.get(EXEMPLAR_AGE_ENV)
+    if raw:
+        try:
+            age = float(raw)
+        except ValueError:
+            age = -1.0
+        if age <= 0:
+            raise ValueError(
+                f"{EXEMPLAR_AGE_ENV}={raw!r} is not a positive float")
+        _exemplar_age_s = age
+    else:
+        _exemplar_age_s = EXEMPLAR_MAX_AGE_S
+
+
+_exemplar_cap = EXEMPLAR_CAP
+_exemplar_age_s = EXEMPLAR_MAX_AGE_S
+_resolve_exemplar_knobs()
+
 
 class Counter:
     """Monotone counter; ``inc`` only (negative increments refused).
@@ -140,12 +182,12 @@ class Histogram:
     def _note_exemplar(self, v: float, trace_id: str, mono: float) -> None:
         """Retain ``trace_id`` when ``v`` ranks among the worst recent
         samples.  Caller holds ``self._lock``."""
-        cutoff = mono - EXEMPLAR_MAX_AGE_S
+        cutoff = mono - _exemplar_age_s
         ex = [e for e in self._ex if e[3] >= cutoff]
-        if len(ex) < EXEMPLAR_CAP or v > ex[-1][0]:
+        if len(ex) < _exemplar_cap or (ex and v > ex[-1][0]):
             ex.append((v, str(trace_id), time.time(), mono))
             ex.sort(key=lambda e: -e[0])
-            del ex[EXEMPLAR_CAP:]
+            del ex[_exemplar_cap:]
         self._ex = ex
 
     def observe(self, value: float, exemplar: Optional[str] = None) -> None:
@@ -168,7 +210,7 @@ class Histogram:
         ``[{"value", "trace_id", "ts"}, ...]`` (``ts`` is wall time).
         Ages out on READ as well as on write — a series whose traffic
         stopped must not pin yesterday's spike forever."""
-        cutoff = time.monotonic() - EXEMPLAR_MAX_AGE_S
+        cutoff = time.monotonic() - _exemplar_age_s
         with self._lock:
             if any(e[3] < cutoff for e in self._ex):
                 self._ex = [e for e in self._ex if e[3] >= cutoff]
@@ -385,6 +427,7 @@ def reset(enabled: Optional[bool] = None) -> MetricsRegistry:
     global _registry
     with _state_lock:
         want = _env_enabled() if enabled is None else bool(enabled)
+        _resolve_exemplar_knobs()
         _registry = MetricsRegistry() if want else _NoopRegistry()
         return _registry
 

@@ -200,6 +200,14 @@ DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
     Objective(name="tenant_request_p99", kind="quantile",
               hist=names.TENANT_REQUEST_LATENCY, quantile="p99",
               threshold=1.0, group_by="tenant"),
+    # audited quality: deficient (recall@k < 1) audited queries per
+    # replayed query, per tenant — the shadow audit sampler
+    # (knn_tpu.obs.audit) feeds both counters; audit-free processes
+    # produce no series -> empty groups, zero cost.  A breach writes
+    # a postmortem bundle embedding the failing audit records.
+    Objective(name="audit_recall", kind="ratio",
+              num=names.AUDIT_DEFICIENT, den=names.AUDIT_REPLAYED,
+              target=0.999, group_by="tenant"),
 )
 
 

@@ -1746,19 +1746,29 @@ class ShardedKNN:
             thr_p[:take] = mid
             thr_s = shard(thr_p, self.mesh, QUERY_AXIS)
             count_out.append((
-                lo, take, js, qp, thr_s,
+                lo, take, js, qp, thr_s, mid, d_m[:, k - 1].copy(),
                 _retry_transient(lambda q=qp, t=thr_s: count_fn(q, self._tp, t),
                                  "count dispatch"),
             ))
 
         # stage 3: collect certificates (count <= per-query rank bound)
         flagged = []
-        for lo, take, js, qp, thr_s, c in count_out:
+        for lo, take, js, qp, thr_s, mid, d_k, c in count_out:
             c_np = _fetch_or_redispatch(
                 c, lambda q=qp, t=thr_s: count_fn(q, self._tp, t),
                 "count fetch")
             over = c_np[:take] > js
             flagged.append(lo + np.flatnonzero(over))
+            # certificate-margin telemetry: per certified query, the
+            # headroom between the k-th refined distance and the count
+            # threshold it was proven against (relative; ~0 = one
+            # near-boundary point away from a fallback)
+            ok = ~over
+            if obs.enabled() and ok.any():
+                denom = np.maximum(np.abs(mid[ok]), 1e-30)
+                obs.histogram(_mn.CERTIFIED_MARGIN, path="sharded"
+                              ).observe_many(
+                    ((mid[ok] - d_k[ok]) / denom).tolist())
         return np.concatenate(flagged) if flagged else np.empty(0, np.int64)
 
     def _pallas_setup(self, margin: int, tile_n: Optional[int],

@@ -99,7 +99,8 @@ class PendingSearch:
 
     def __init__(self, engine: "ServingEngine", op: str, chunks, n: int,
                  t0: float, trace_id: Optional[str] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 audit_queries: Optional[np.ndarray] = None):
         self._engine = engine
         self._op = op
         self._chunks = chunks  # [(device outputs, redo, rows)]
@@ -112,6 +113,9 @@ class PendingSearch:
         #: tenant tag for per-tenant latency/error attribution (None =
         #: untagged: produces no tenant series at all)
         self.tenant = tenant
+        #: query copy pinned at submit when the shadow audit sampler
+        #: selected this request (knn_tpu.obs.audit); None = unsampled
+        self._audit_queries = audit_queries
 
     def result(self):
         from knn_tpu.parallel.sharded import _fetch_or_redispatch
@@ -156,6 +160,8 @@ class PendingSearch:
                                          trace_id=self.trace_id,
                                          rows=self._n,
                                          tenant=self.tenant)
+            if self._audit_queries is not None:
+                self._engine._submit_audit(self, res)
         return res
 
 
@@ -416,6 +422,14 @@ class ServingEngine:
                 f"{self._dim}")
         if trace_id is None:
             trace_id = obs.new_trace_id()
+        # shadow audit sampling (knn_tpu.obs.audit): the only hot-path
+        # costs are one trace-id hash plus, on the sampled fraction, one
+        # query copy pinned here so a later in-place caller mutation
+        # cannot corrupt the replay.  The oracle scan itself runs on the
+        # audit worker thread, never here.
+        audit_q = (q.copy()
+                   if op == "search" and obs.audit.sampled(trace_id)
+                   else None)
         t0 = time.perf_counter()
         try:
             with obs.span("serving.dispatch", trace_id=trace_id, op=op,
@@ -444,7 +458,7 @@ class ServingEngine:
         if tenant is not None:
             obs.counter(mn.TENANT_REQUESTS, tenant=tenant).inc()
         return PendingSearch(self, op, chunks, q.shape[0], t0, trace_id,
-                             tenant)
+                             tenant, audit_queries=audit_q)
 
     def search(self, queries, *, return_sqrt: bool = False):
         """Bucketed exact search: (distances [Q, k], indices [Q, k]) as
@@ -520,6 +534,57 @@ class ServingEngine:
                         **({} if rows is None else {"rows": int(rows)}),
                         **({} if tenant is None else {"tenant": tenant}))
 
+    def _submit_audit(self, handle: PendingSearch, res) -> None:
+        """Enqueue one sampled, already-served request for off-path
+        exact replay (knn_tpu.obs.audit).  Cheap here — one bounded
+        queue put under the sampler's row budget; the oracle closure
+        below (full-database f64 scan via ops.refine) runs ONLY on the
+        audit worker thread.  Failure-proof: the request was already
+        served, so a broken audit layer degrades to a dropped record,
+        never an exception into the caller."""
+        try:
+            d, i = res
+            program = self.program
+            k = self.k
+            metric = program.metric
+
+            def oracle(queries, served_ids):
+                from knn_tpu.ops.refine import (
+                    _pairwise_f64,
+                    refine_shared_exact,
+                )
+
+                db = program._host_train()  # may raise -> loud drop
+                # dot placements are norm-augmented one column wider
+                # than the request dim; original rows are the first
+                # D columns (queries ride with a zero column appended)
+                if db.shape[1] != queries.shape[1]:
+                    db = db[:, : queries.shape[1]]
+                n = db.shape[0]
+                od, oi = refine_shared_exact(
+                    db, queries, np.arange(n), k, metric=metric)
+                ids = np.asarray(served_ids, np.int64)[:, :k]
+                valid = (ids >= 0) & (ids < n)
+                safe = np.where(valid, ids, 0)
+                se = _pairwise_f64(queries, db[safe], metric)
+                return od, oi, np.where(valid, se, np.inf)
+
+            q_audit = handle._audit_queries
+            obs.audit.submit(obs.audit.AuditRecord(
+                trace_id=handle.trace_id,
+                tenant=handle.tenant,
+                k=k,
+                queries=q_audit,
+                served_d=np.asarray(d),
+                served_ids=np.asarray(i),
+                epoch=None,
+                cost_rows=int(q_audit.shape[0]) * int(program.n_train),
+                oracle=oracle,
+            ))
+        except Exception:  # noqa: BLE001 - audit must not fail serving
+            obs.emit_event("audit.submit_error", op=handle._op,
+                           trace_id=handle.trace_id)
+
     def _record_error(self, op: str, *,
                       tenant: Optional[str] = None) -> None:
         with self._lock:
@@ -587,11 +652,22 @@ class ServingEngine:
         # overlap=True): absent until one happened on this placement, so
         # the default stats() shape is untouched
         pipeline = getattr(self.program, "_last_pipeline", None)
+        # the shadow audit sampler's quality section: present only when
+        # the sampler is armed (rate > 0 AND telemetry on), so both the
+        # obs-off and the audit-off stats() shapes are unchanged
+        quality = None
+        if obs.enabled():
+            try:
+                if obs.audit.audit_rate() > 0:
+                    quality = obs.audit.status()
+            except Exception:  # pragma: no cover - stats must not die
+                quality = None
         with self._lock:
             return {
                 **({"tuning": tuning_info} if tuning_info else {}),
                 **({"pipeline": dict(pipeline)} if pipeline else {}),
                 **({"slo": slo_section} if slo_section else {}),
+                **({"quality": quality} if quality else {}),
                 **({"slowest_requests": slowest}
                    if slowest is not None else {}),
                 "buckets": list(self.buckets),

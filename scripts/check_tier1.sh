@@ -51,6 +51,7 @@ if [ "${1:-}" = "--fast" ]; then
     tests/test_multihost.py tests/test_hosttier.py \
     tests/test_ivf.py \
     tests/test_join.py \
+    tests/test_audit.py \
     tests/test_artifact_schema.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi

@@ -125,6 +125,7 @@ def run_lint(repo) -> int:
                             ("mutation", "mutation"),
                             ("ivf", "ivf"),
                             ("join", "join"),
+                            ("quality", "quality"),
                             ("multihost", "multihost"),
                             ("sentinel", "sentinel verdict")):
             viol = sum(1 for p in problems if p["schema"] == name)

@@ -184,7 +184,8 @@ def test_version_tokens_resolve_and_are_owned_once():
                       "mutation_version": "mutation",
                       "ivf_version": "ivf",
                       "pq_version": "pq",
-                      "join_version": "join"}
+                      "join_version": "join",
+                      "quality_version": "quality"}
 
 
 def test_catalog_refuses_duplicate_version_tokens():
@@ -223,6 +224,7 @@ def test_sentinel_curated_fields_derived_in_legacy_order():
         ("ivf_qps", "higher"),
         ("bytes_streamed_ratio", "lower"),
         ("join_rows_per_s", "higher"),
+        ("audit_recall_at_k", "higher"),
     )
 
 

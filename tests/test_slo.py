@@ -47,11 +47,12 @@ def test_default_objectives_validate_against_catalog():
     assert {o.name for o in objs} == {
         "serving_availability", "serving_request_p99", "queue_wait_p95",
         "certified_fallback_rate", "certified_false_alarm_rate",
-        "tenant_availability", "tenant_request_p99"}
-    # the tenant objectives are the grouped ones: one burn-rate
-    # evaluation per tenant label value, not one global sum
+        "tenant_availability", "tenant_request_p99", "audit_recall"}
+    # the tenant-grouped objectives: one burn-rate evaluation per
+    # tenant label value, not one global sum (audit_recall groups by
+    # the audited request's tenant the same way)
     assert {o.name for o in objs if o.group_by == "tenant"} == {
-        "tenant_availability", "tenant_request_p99"}
+        "tenant_availability", "tenant_request_p99", "audit_recall"}
     for o in objs:
         o.validate()  # must not raise
 
