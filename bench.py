@@ -41,14 +41,18 @@ Env overrides:
   KNN_BENCH_CONFIG   sift1m (default) | glove | gist1m   (BASELINE configs 3/4/5)
   KNN_BENCH_MODES    comma list from {exact,certified_approx,
                      certified_pallas,serving,knee,multihost,mutation,
-                     ivf,join,quality}; ``join`` is the opt-in bulk
-                     kNN-join line (knn_tpu.join: double-buffered
+                     ivf,join,quality,fleet}; ``join`` is the opt-in
+                     bulk kNN-join line (knn_tpu.join: double-buffered
                      superblock stream vs looped serving on the same
                      placement; KNN_BENCH_JOIN_ROWS/_SUPERBLOCK/_DEPTH
                      shape it); ``quality`` is the opt-in shadow-audit
                      replay (knn_tpu.obs.audit at rate 1.0:
                      KNN_BENCH_QUALITY_REQUESTS requests re-scored
-                     against the f64 exact oracle)
+                     against the f64 exact oracle); ``fleet`` is the
+                     opt-in cross-host telemetry merge
+                     (knn_tpu.obs.fleet over KNN_TPU_FLEET_MEMBERS, or
+                     this process's own snapshot as a one-member
+                     fleet)
   KNN_BENCH_RUNS     timed repetitions per mode (default 5)
   KNN_BENCH_N, KNN_BENCH_DIM, KNN_BENCH_K, KNN_BENCH_NQ, KNN_BENCH_BATCH,
   KNN_BENCH_TILE, KNN_BENCH_CPU_QUERIES, KNN_BENCH_MARGIN,
@@ -1384,6 +1388,32 @@ def main() -> None:
                     os.environ[k] = v
             _audit.reset_auditor()
 
+    def sweep_fleet():
+        """Opt-in fleet-plane measurement (knn_tpu.obs.fleet): merge
+        the fleet's telemetry and emit the validated ``fleet`` artifact
+        block.  With ``KNN_TPU_FLEET_MEMBERS`` set it collects the
+        live endpoints; otherwise it snapshots THIS process and merges
+        the one-member fleet — the offline proof that the collect ->
+        merge -> block pipeline holds on every bench host."""
+        import tempfile as _tempfile
+
+        from knn_tpu import obs as _obs
+        from knn_tpu.obs import fleet as _fleet
+
+        t0 = time.perf_counter()
+        if not _obs.enabled():
+            block = _fleet.artifact_block(_fleet.live_fleet_report())
+        elif _fleet.fleet_members():
+            block = _fleet.artifact_block(_fleet.fleet_report())
+        else:
+            with _tempfile.TemporaryDirectory() as d:
+                _obs.write_json_snapshot(
+                    os.path.join(d, "self.json"))
+                block = _fleet.artifact_block(
+                    _fleet.fleet_report(snapshot_dir=d))
+        block["wall_s"] = round(time.perf_counter() - t0, 4)
+        return {"fleet": block}
+
     def roofline_for_mode(mode, entry):
         """The selector's ``roofline`` block (knn_tpu.obs.roofline):
         analytic ceiling q/s + bound class for the config this mode
@@ -1742,6 +1772,15 @@ def main() -> None:
             # a throughput competitor
             try:
                 entry = sweep_quality()
+            except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
+                entry = {"error": f"{type(e).__name__}: {e}"}
+            results[mode] = entry
+            continue
+        if mode == "fleet":
+            # cross-host telemetry merge: an observability ledger,
+            # never a throughput competitor
+            try:
+                entry = sweep_fleet()
             except Exception as e:  # noqa: BLE001 — one bad mode must not kill the line
                 entry = {"error": f"{type(e).__name__}: {e}"}
             results[mode] = entry

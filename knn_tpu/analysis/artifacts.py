@@ -1581,6 +1581,39 @@ CATALOG: Tuple[BlockSchema, ...] = (
             Field("error", "any"),
         ),
     ),
+    # --- fleet observability (cross-host merge) --------------------------
+    BlockSchema(
+        name="fleet",
+        block_path="fleet",
+        doc="docs/OBSERVABILITY.md#Fleet observability",
+        emitters=("knn_tpu/obs/fleet.py", "bench.py"),
+        fingerprints=(frozenset({"fleet_version", "member_count"}),),
+        version_field="fleet_version",
+        version_ref=Ref("knn_tpu.obs.fleet", "FLEET_VERSION"),
+        version_exact=True,
+        not_dict_legacy="fleet block must be a dict, got {vtype}",
+        error_exempt="validator",
+        refusal_label="fleet",
+        sweep=True,
+        # the merged cross-host headline: how many members summed in,
+        # how loudly partial the merge was, who the straggler is
+        checks=(
+            Field("fleet_version", "version", required=True),
+            Field("catalog_version", "str", required=True),
+            Field("member_count", "int", required=True, ge=0),
+            Field("expected_members", "int", required=True, ge=0),
+            Field("unreachable_count", "int", required=True, ge=0),
+            Field("skewed_count", "int", required=True, ge=0),
+            Field("partial", "bool", required=True),
+            Field("staleness_s", "number", required=True, ge=0),
+            Field("straggler_host", "int", nullable=True),
+            Field("straggler_gap_s", "number", nullable=True, ge=0),
+            Field("stitched_requests", "int", required=True, ge=0),
+            Field("slo_breached", "int", required=True, ge=0),
+            Field("wall_s", "any"),
+            Field("error", "any"),
+        ),
+    ),
     # --- sentinel verdict ------------------------------------------------
     BlockSchema(
         name="sentinel",

@@ -97,6 +97,16 @@ SWITCHES: Tuple[Switch, ...] = (
        "(default 8; 0 disables retention)."),
     _s("KNN_TPU_OBS_EXEMPLAR_AGE_S", "float", "knn_tpu/obs/registry.py",
        _OBS, "Exemplar age-out horizon in seconds (default 600)."),
+    # --- fleet observability plane (knn_tpu.obs.fleet) -----------------
+    _s("KNN_TPU_FLEET_MEMBERS", "spec", "knn_tpu/obs/fleet.py", _OBS,
+       "Comma/space-separated host:port list of fleet member metric "
+       "endpoints the aggregator collects /metrics.json + /statusz "
+       "from (/fleetz, cli fleet); unset = fleet plane unconfigured."),
+    _s("KNN_TPU_FLEET_STALE_S", "float", "knn_tpu/obs/fleet.py", _OBS,
+       "Staleness refusal threshold (seconds, default 120): a member "
+       "snapshot older than the newest by more than this is refused "
+       "as a different collection round and listed loudly under "
+       "unreachable instead of silently understating the merge."),
     # --- shadow audit sampler (knn_tpu.obs.audit) ----------------------
     _s("KNN_TPU_AUDIT_RATE", "float", "knn_tpu/obs/audit.py", _OBS,
        "Fraction of live requests the shadow audit sampler replays "

@@ -52,6 +52,20 @@ offline from an atomic snapshot — the same report either way, jax-free
 by construction.  Exit code: 0 healthy, 2 not ready, 1 unreadable
 source.
 
+    python -m knn_tpu.cli fleet --members host0:9100,host1:9100
+    python -m knn_tpu.cli fleet --snapshot-dir /path/snapshots [--json]
+
+collects every fleet member's telemetry (live ``/metrics.json`` +
+``/statusz`` endpoints, or a directory of atomic snapshots plus event
+logs) and renders ONE merged cross-host report (knn_tpu.obs.fleet):
+counters summed bitwise-deterministically, gauges kept per-host with
+min/max/argmax, fleet quantiles taken ONLY from element-wise-summed
+histogram buckets (never averaged percentiles), the named straggler
+host, fleet SLO verdicts, and the stitched cross-host waterfalls.
+Unreachable / torn / stale / catalog-skewed members render loudly as a
+partial fleet.  Exit code: 0 healthy, 2 partial or breached, 1
+unreadable source (docs/OBSERVABILITY.md "Fleet observability").
+
     python -m knn_tpu.cli audit --port 9100
     python -m knn_tpu.cli audit --bundle postmortem-....json
 
@@ -513,6 +527,83 @@ def run_doctor(args: argparse.Namespace) -> int:
     else:
         sys.stdout.write(health.render_text(report))
     return 0 if report.get("readiness", {}).get("ready") else 2
+
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="knn_tpu fleet",
+        description="Collect every fleet member's telemetry and render "
+        "ONE merged cross-host report (knn_tpu.obs.fleet): counters "
+        "summed, gauges kept per-host with min/max/argmax, quantiles "
+        "from element-wise-summed histogram buckets (never averaged "
+        "percentiles), the named straggler host, and stitched "
+        "cross-host waterfalls.  Exit 0 healthy, 2 partial fleet / "
+        "nothing merged / fleet SLO breached, 1 unreadable source.",
+    )
+    p.add_argument("--members", default=None, metavar="HOST:PORT,...",
+                   help="comma/space-separated live member endpoints "
+                   "(default: KNN_TPU_FLEET_MEMBERS)")
+    p.add_argument("--snapshot-dir", default=None, metavar="DIR",
+                   help="merge offline from a directory of atomic JSON "
+                   "snapshots (*.json) + optional event logs (*.jsonl, "
+                   "stitched into cross-host waterfalls)")
+    p.add_argument("--snapshot", action="append", default=None,
+                   metavar="PATH",
+                   help="merge offline from explicit snapshot files "
+                   "(repeatable)")
+    p.add_argument("--stale-s", type=float, default=None,
+                   help="refuse members older than the newest by more "
+                   "than this many seconds (default: "
+                   "KNN_TPU_FLEET_STALE_S or %s)"
+                   % "120")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="per-member HTTP timeout for live collection")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw merged report JSON instead of "
+                   "the human-readable rendering")
+    return p
+
+
+def run_fleet(args: argparse.Namespace) -> int:
+    """The `fleet` subcommand — jax-free (knn_tpu.obs imports no JAX):
+    merging a fleet's telemetry must not pay a backend init."""
+    import json
+    import os
+
+    from knn_tpu.obs import fleet
+
+    members = None
+    if args.members:
+        import re as _re
+
+        members = [m for m in _re.split(r"[,\s]+", args.members) if m]
+    if args.snapshot_dir is not None and not os.path.isdir(
+            args.snapshot_dir):
+        print(f"cannot read snapshot dir {args.snapshot_dir}: "
+              f"not a directory", file=sys.stderr)
+        return 1
+    if members is None and args.snapshot_dir is None \
+            and args.snapshot is None and not fleet.fleet_members():
+        print("no fleet source: pass --members/--snapshot-dir/--snapshot "
+              f"or set {fleet.MEMBERS_ENV}", file=sys.stderr)
+        return 1
+    try:
+        report = fleet.fleet_report(
+            members, snapshot_dir=args.snapshot_dir,
+            snapshot_files=args.snapshot, timeout_s=args.timeout,
+            stale_s=args.stale_s)
+    except OSError as e:
+        print(f"fleet collection failed: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True, default=str))
+    else:
+        print(fleet.render_text(report))
+    if not report.get("enabled", True):
+        return 2
+    unhealthy = (report["partial"] or report["member_count"] == 0
+                 or bool((report.get("slo") or {}).get("breached")))
+    return 2 if unhealthy else 0
 
 
 def build_audit_parser() -> argparse.ArgumentParser:
@@ -1466,6 +1557,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return run_metrics(build_metrics_parser().parse_args(argv[1:]))
     if argv[:1] == ["doctor"]:
         return run_doctor(build_doctor_parser().parse_args(argv[1:]))
+    if argv[:1] == ["fleet"]:
+        return run_fleet(build_fleet_parser().parse_args(argv[1:]))
     if argv[:1] == ["audit"]:
         return run_audit(build_audit_parser().parse_args(argv[1:]))
     if argv[:1] == ["index"]:

@@ -41,6 +41,13 @@ writes through here instead of keeping private ad-hoc counters:
 - **Drift detection** (:mod:`knn_tpu.obs.drift`): streaming query
   distribution sketches (norms, centroid assignments) scored by PSI
   against train-time baselines, plus index-health gauges.
+- **Fleet plane** (:mod:`knn_tpu.obs.fleet`): N processes' telemetry
+  merged into one cross-host report — counters summed, gauges kept
+  per-host with min/max/argmax, quantiles from element-wise-summed
+  histogram buckets (never averaged percentiles), stitched multi-host
+  waterfalls, fleet SLO edges with member-embedding postmortems
+  (``KNN_TPU_FLEET_MEMBERS``, ``/fleetz``, ``cli fleet``); every
+  payload stamped with the process identity (:mod:`knn_tpu.obs.ident`).
 
 The package itself imports no JAX (jax_hooks defers it), so the CLI's
 flag parsing and the lint script stay import-light.
@@ -53,7 +60,9 @@ from knn_tpu.obs import (  # noqa: F401
     audit,
     blackbox,
     drift,
+    fleet,
     health,
+    ident,
     names,
     profiler,
     roofline,
@@ -104,8 +113,9 @@ __all__ = [
     "NOOP", "Counter", "EventLog", "Gauge", "Histogram",
     "MetricsRegistry", "Objective", "SLOEngine", "audit", "blackbox",
     "compact_snapshot", "drift",
-    "counter", "emit_event", "enabled", "gauge", "get_event_log",
-    "get_registry", "get_slo_engine", "health", "histogram",
+    "counter", "emit_event", "enabled", "fleet", "gauge",
+    "get_event_log",
+    "get_registry", "get_slo_engine", "health", "histogram", "ident",
     "install_compile_hook", "load_objectives", "names", "new_trace_id",
     "profiler", "prometheus_text", "record_span", "reset",
     "reset_event_log", "reset_slo_engine", "roofline", "sentinel", "slo",

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Optional
 
-from knn_tpu.obs import registry
+from knn_tpu.obs import ident, registry
 
 #: summary quantiles exported from the histogram window
 _QUANTILES = (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))
@@ -77,6 +77,21 @@ def prometheus_text(snapshot: Optional[dict] = None) -> str:
                         f"{name}{_labels_str(ls, ('quantile', '0.99'))} "
                         f'{{trace_id="{_esc(str(ex["trace_id"]))}"}} '
                         f'{ex["value"]} {ex["ts"]}')
+                if v.get("buckets"):
+                    # the mergeable form: cumulative counts over the
+                    # fixed registry.BUCKET_BOUNDS grid, classic
+                    # ``_bucket{le=...}`` lines — identical bounds in
+                    # every process is what lets the fleet aggregator
+                    # add them and take quantiles of the SUM
+                    cum = v["buckets"]
+                    for b, c in zip(registry.BUCKET_BOUNDS, cum):
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_labels_str(ls, ('le', format(b, '.6g')))} "
+                            f"{c}")
+                    lines.append(
+                        f"{name}_bucket{_labels_str(ls, ('le', '+Inf'))} "
+                        f"{cum[-1]}")
                 lines.append(f"{name}_sum{_labels_str(ls)} {v['sum']}")
                 lines.append(f"{name}_count{_labels_str(ls)} {v['count']}")
             else:
@@ -114,7 +129,9 @@ def write_json_snapshot(path: str, snapshot: Optional[dict] = None) -> dict:
 
     payload = {
         "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "written_at_unix": round(time.time(), 3),
         "pid": os.getpid(),
+        "identity": ident.identity(),
         "enabled": registry.enabled(),
         "metrics": registry.snapshot() if snapshot is None else snapshot,
         "health": health.report(),
@@ -136,9 +153,11 @@ def start_metrics_server(port: int, host: str = "127.0.0.1"):
     """Serve ``/metrics`` (Prometheus text), ``/metrics.json`` (the full
     snapshot), ``/healthz`` (liveness/readiness probe: 200 only once
     warmup completed and worker threads are live — knn_tpu.obs.health),
-    ``/statusz`` (the full self-diagnosis report), and ``/waterfallz``
+    ``/statusz`` (the full self-diagnosis report), ``/waterfallz``
     (per-request latency waterfalls + critical-path attribution —
-    knn_tpu.obs.waterfall) from a daemon
+    knn_tpu.obs.waterfall), and ``/fleetz`` (the merged cross-host
+    fleet report over ``KNN_TPU_FLEET_MEMBERS`` — knn_tpu.obs.fleet)
+    from a daemon
     thread; returns the server (``.shutdown()`` to stop;
     ``.server_address[1]`` for the bound port — pass port 0 to let the
     OS pick one)."""
@@ -156,6 +175,8 @@ def start_metrics_server(port: int, host: str = "127.0.0.1"):
             elif path == "/metrics.json":
                 body = json.dumps(
                     {"enabled": registry.enabled(),
+                     "identity": ident.identity(),
+                     "written_at_unix": round(time.time(), 3),
                      "metrics": registry.snapshot()},
                     indent=1, sort_keys=True).encode()
                 ctype = "application/json"
@@ -175,6 +196,15 @@ def start_metrics_server(port: int, host: str = "127.0.0.1"):
                 # waterfall from the live ring, attribution, and the
                 # slowest-requests table (cli `waterfall --port`)
                 body = json.dumps(waterfall.live_report(), indent=1,
+                                  sort_keys=True, default=str).encode()
+                ctype = "application/json"
+            elif path == "/fleetz":
+                from knn_tpu.obs import fleet
+
+                # the merged fleet report over KNN_TPU_FLEET_MEMBERS
+                # (knn_tpu.obs.fleet) — partial collections render
+                # loudly with their unreachable/skewed members listed
+                body = json.dumps(fleet.live_fleet_report(), indent=1,
                                   sort_keys=True, default=str).encode()
                 ctype = "application/json"
             else:

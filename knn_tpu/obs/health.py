@@ -40,7 +40,7 @@ import time
 import weakref
 from typing import List, Optional
 
-from knn_tpu.obs import names, registry, roofline, slo, trace
+from knn_tpu.obs import ident, names, registry, roofline, slo, trace
 
 #: alert events included in the report (newest last)
 REPORT_ALERTS = 20
@@ -308,6 +308,10 @@ def report(slo_section: Optional[dict] = None,
         "generated_at": time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "pid": os.getpid(),
+        # who this process is (host, process_index/count, device kind,
+        # coordinator, commit, catalog version) — the fleet aggregator
+        # keys members and detects catalog skew off this stamp
+        "identity": ident.identity(),
         "obs_enabled": registry.enabled(),
         "liveness": {"live": pr["live"]},
         "readiness": {"ready": pr["ready"], "reasons": pr["reasons"]},
@@ -510,11 +514,16 @@ def render_text(rep: dict) -> str:
     mh = rep.get("multihost")
     if mh:
         walls = mh.get("host_walls_s") or []
+        sh = mh.get("straggler_host")
+        # the named slow host: per-host walls (not just max-min) are in
+        # the report, so the argmax renders here and the fleet view can
+        # attribute the gap to a member
+        sh_s = f" straggler=host{sh}" if sh is not None else ""
         lines.append(
             f"multihost: {mh.get('hosts')} host(s) "
             f"[{mh.get('transport')}] dcn_merge={mh.get('dcn_merge')} "
             f"bytes={mh.get('dcn_merge_bytes')} "
-            f"straggler_gap={mh.get('straggler_gap_s')}s "
+            f"straggler_gap={mh.get('straggler_gap_s')}s{sh_s} "
             f"(walls {', '.join(str(w) for w in walls)})")
     breaches = rep.get("active_breaches", [])
     lines.append(f"slo breaches: {', '.join(breaches) if breaches else 'none'}")

@@ -1,0 +1,105 @@
+"""Process identity — the stamp that makes multi-process telemetry
+attributable.
+
+Since PR 12 one replica spans jax.distributed processes, but snapshots
+and JSONL event lines were anonymous: merge two hosts' rotated logs and
+nothing says which line came from where.  This module is the ONE
+jax-free home for the identity every telemetry payload carries —
+``write_json_snapshot``/``/metrics.json`` (knn_tpu.obs.export) and
+every ``KNN_TPU_OBS_LOG`` event (knn_tpu.obs.trace) stamp it, and the
+fleet aggregator (knn_tpu.obs.fleet) keys members and detects
+catalog-version skew off it.
+
+Defaults are honest for a single process (pid + hostname, process 0 of
+1, unknown device/coordinator); the jax-side multi-host path calls
+:func:`set_identity` with the real process_index / host count / device
+kind / coordinator address at init — this module itself never imports
+jax.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Dict, Optional
+
+from knn_tpu.obs import names
+
+_lock = threading.Lock()
+_overrides: Dict[str, object] = {}
+_commit: Optional[str] = None
+_commit_resolved = False
+
+
+def _resolve_commit() -> Optional[str]:
+    """The repo HEAD commit, read straight from ``.git`` (no
+    subprocess, works from any checkout depth); None outside a git
+    checkout or on any read problem."""
+    d = os.path.dirname(os.path.abspath(__file__))
+    for _ in range(8):
+        head = os.path.join(d, ".git", "HEAD")
+        if os.path.isfile(head):
+            try:
+                with open(head) as f:
+                    ref = f.read().strip()
+                if ref.startswith("ref:"):
+                    ref_path = os.path.join(
+                        d, ".git", *ref.split(None, 1)[1].split("/"))
+                    with open(ref_path) as f:
+                        return f.read().strip()[:12]
+                return ref[:12]
+            except OSError:
+                return None
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+def set_identity(**fields) -> None:
+    """Override identity fields (the multi-host init path stamps the
+    real process_index / process_count / device_kind /
+    coordinator_address here).  Unknown field names are refused — a
+    typo'd stamp must not silently vanish from every payload."""
+    allowed = {"host", "process_index", "process_count", "device_kind",
+               "coordinator_address", "commit"}
+    bad = set(fields) - allowed
+    if bad:
+        raise ValueError(
+            f"unknown identity field(s) {sorted(bad)}; "
+            f"allowed: {sorted(allowed)}")
+    with _lock:
+        _overrides.update(fields)
+
+
+def reset_identity() -> None:
+    """Drop every override (tests)."""
+    with _lock:
+        _overrides.clear()
+
+
+def identity() -> dict:
+    """The current process identity stamp: host, pid, process_index,
+    process_count, device_kind, coordinator_address, commit, and the
+    metric catalog-version token (the fleet skew check's key)."""
+    global _commit, _commit_resolved
+    if not _commit_resolved:
+        c = _resolve_commit()
+        with _lock:
+            _commit, _commit_resolved = c, True
+    with _lock:
+        ov = dict(_overrides)
+    out = {
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "process_index": 0,
+        "process_count": 1,
+        "device_kind": None,
+        "coordinator_address": None,
+        "commit": _commit,
+        "catalog_version": names.catalog_version(),
+    }
+    out.update(ov)
+    return out

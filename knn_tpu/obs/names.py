@@ -12,9 +12,30 @@ nor document a phantom one.
 Names follow the Prometheus conventions the exporters assume: a
 ``knn_tpu_`` namespace prefix, ``_total`` suffix on counters, ``_seconds``
 on time-valued metrics, base units throughout.
+
+:func:`catalog_version` digests the whole catalog into a short token.
+Identity-stamped snapshots carry it (knn_tpu.obs.export), and the fleet
+aggregator refuses to merge members whose token differs — summing a
+counter whose meaning changed between versions would silently produce
+nonsense (knn_tpu.obs.fleet lists such members under ``skewed``).
 """
 
 from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+
+
+@lru_cache(maxsize=1)
+def catalog_version() -> str:
+    """A 12-hex digest of every (name, kind, labels) triple in the
+    catalog — help-string edits don't move it, but adding/removing a
+    metric or changing its kind/labels does."""
+    h = hashlib.sha256()
+    for name in sorted(CATALOG):
+        kind, labels, _help = CATALOG[name]
+        h.update(f"{name}|{kind}|{','.join(sorted(labels))}\n".encode())
+    return h.hexdigest()[:12]
 
 # --- serving engine (knn_tpu.serving.engine) ---------------------------
 SERVING_REQUESTS = "knn_tpu_serving_requests_total"
@@ -149,6 +170,12 @@ DRIFT_QUERIES = "knn_tpu_drift_queries_observed_total"
 INDEX_LIST_IMBALANCE = "knn_tpu_index_list_imbalance"
 INDEX_TAIL_FRACTION = "knn_tpu_index_delta_tail_fraction"
 INDEX_TOMBSTONE_DENSITY = "knn_tpu_index_tombstone_density"
+
+# --- fleet observability plane (knn_tpu.obs.fleet) ---------------------
+FLEET_MEMBERS = "knn_tpu_fleet_members"
+FLEET_UNREACHABLE = "knn_tpu_fleet_unreachable"
+FLEET_MERGE_STALENESS = "knn_tpu_fleet_merge_staleness_seconds"
+FLEET_STRAGGLER_HOST = "knn_tpu_fleet_straggler_host"
 
 #: name -> (type, label names, help).  Types: "counter" (monotone,
 #: float-valued so second-counters work), "gauge", "histogram" (bounded
@@ -506,4 +533,23 @@ CATALOG = {
         "gauge", (),
         "Fraction of all index rows tombstoned — dead bytes diluting "
         "every stream until compaction drops them."),
+    FLEET_MEMBERS: (
+        "gauge", (),
+        "Members the last fleet collection merged (knn_tpu.obs.fleet) "
+        "— live endpoints reached or snapshot files read."),
+    FLEET_UNREACHABLE: (
+        "gauge", (),
+        "Members the last fleet collection could NOT merge "
+        "(unreachable endpoint, torn/unreadable snapshot, or "
+        "catalog-version skew) — nonzero marks the report partial."),
+    FLEET_MERGE_STALENESS: (
+        "gauge", (),
+        "Spread (seconds) between the oldest and newest member "
+        "snapshot the last fleet collection merged — how far apart in "
+        "time the merged numbers are."),
+    FLEET_STRAGGLER_HOST: (
+        "gauge", ("host",),
+        "1 on the member whose per-host DCN-merge wall time was the "
+        "fleet maximum in the last collection (the named straggler), "
+        "0 on the others."),
 }

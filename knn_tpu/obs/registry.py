@@ -27,6 +27,7 @@ never touches numerics; tests/test_obs.py pins both properties).
 
 from __future__ import annotations
 
+import bisect
 import os
 import re
 import threading
@@ -45,6 +46,17 @@ OBS_ENV = "KNN_TPU_OBS"
 
 #: bounded histogram window (samples per labeled series)
 DEFAULT_WINDOW = 4096
+
+#: fixed log-spaced histogram bucket upper bounds, 4 per decade over
+#: 1e-6..1e4 (covers microsecond latencies through multi-kilosecond
+#: walls and the quant-bound epsilons).  FIXED — same bounds in every
+#: process — is the whole point: cumulative counts over identical
+#: bounds add across hosts, so fleet quantiles can be computed from the
+#: merged distribution instead of unsoundly averaging per-host
+#: percentiles (knn_tpu.obs.fleet).  An observation past the last
+#: bound lands in the implicit +Inf overflow slot.
+BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    round(10.0 ** (-6 + i / 4.0), 10) for i in range(41))
 
 #: worst-recent exemplars retained per histogram series (trace ids of
 #: the samples that blew the tail — the histogram->trace join)
@@ -161,7 +173,7 @@ class Histogram:
     one ``is None`` check and nothing else."""
 
     __slots__ = ("_lock", "_count", "_sum", "_min", "_max", "_window",
-                 "_wts", "_ex")
+                 "_wts", "_ex", "_bkt")
 
     def __init__(self, window: int = DEFAULT_WINDOW):
         self._lock = threading.Lock()
@@ -169,6 +181,11 @@ class Histogram:
         self._sum = 0.0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        #: per-bucket observation counts over BUCKET_BOUNDS (last slot
+        #: is the +Inf overflow); cumulated at export time so snapshots
+        #: carry Prometheus-style ``le`` semantics while observe() pays
+        #: one bisect + one increment
+        self._bkt = [0] * (len(BUCKET_BOUNDS) + 1)
         self._window: deque = deque(maxlen=int(window))
         #: arrival timestamps parallel to _window, so the summary can
         #: say WHICH wall span its percentiles cover — a window
@@ -200,6 +217,7 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            self._bkt[bisect.bisect_left(BUCKET_BOUNDS, v)] += 1
             self._window.append(v)
             self._wts.append(t)
             if exemplar is not None:
@@ -233,6 +251,8 @@ class Histogram:
                 self._min = lo
             if self._max is None or hi > self._max:
                 self._max = hi
+            for v in vs:
+                self._bkt[bisect.bisect_left(BUCKET_BOUNDS, v)] += 1
             self._window.extend(vs)
             self._wts.extend([t] * len(vs))
 
@@ -248,11 +268,23 @@ class Histogram:
         with self._lock:
             count, total = self._count, self._sum
             mn, mx = self._min, self._max
+            bkt = list(self._bkt)
             window = list(self._window)
             wts = list(self._wts)
         out: Dict[str, float] = {"count": count, "sum": total}
         if mn is not None:
             out["min"], out["max"] = mn, mx
+        if count:
+            # cumulative counts over BUCKET_BOUNDS (+Inf last) — the
+            # mergeable form: identical fixed bounds in every process,
+            # so fleet aggregation adds these element-wise and derives
+            # quantiles from the MERGED distribution (never by
+            # averaging per-host percentiles)
+            cum, running = [], 0
+            for c in bkt:
+                running += c
+                cum.append(running)
+            out["buckets"] = cum
         ex = self.exemplars()
         if ex:
             # only exemplar-fed series grow the key: summaries of
@@ -308,6 +340,28 @@ class _Noop:
 
 
 NOOP = _Noop()
+
+
+def quantile_from_buckets(cum, q: float) -> Optional[float]:
+    """The ``q``-quantile (0..1) of a cumulative bucket vector over
+    :data:`BUCKET_BOUNDS` — the bucket's UPPER bound, i.e. a sound
+    upper estimate quantized to the bucket grid.  This is the only
+    valid way to state a fleet quantile: per-host percentiles do not
+    average, but cumulative counts over identical bounds add, and the
+    quantile of the sum is exact to bucket resolution.  Returns None
+    for an empty vector; an overflow-bucket hit returns the last
+    finite bound (the estimate saturates, it never invents +Inf)."""
+    if not cum:
+        return None
+    total = cum[-1]
+    if total <= 0:
+        return None
+    target = q * total
+    for i, c in enumerate(cum):
+        if c >= target and c > 0:
+            return BUCKET_BOUNDS[min(i, len(BUCKET_BOUNDS) - 1)]
+    return BUCKET_BOUNDS[-1]
+
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 

@@ -616,3 +616,149 @@ def slo_report(now: Optional[float] = None) -> dict:
     """Evaluate-and-report: the ``slo`` section ServingEngine.stats()
     and JobResult.metrics() embed ({} when disabled)."""
     return get_slo_engine().evaluate(now=now)
+
+
+# -- fleet evaluation (knn_tpu.obs.fleet) ----------------------------------
+# The fleet plane merges N processes' telemetry into one surface
+# (counters summed, histogram buckets added element-wise); these
+# functions evaluate the SAME objectives over that merged surface.
+# Two deliberate differences from the per-process engine:
+#
+# - LIFETIME ratios, not windowed burn rates: the fleet aggregator has
+#   no cross-process sample ring, so a ratio objective judges the
+#   merged lifetime num/den against the error budget directly.
+# - quantiles come ONLY from the merged cumulative buckets
+#   (registry.quantile_from_buckets over the element-wise sum) — never
+#   from combining per-host percentiles.  _hist_summary's
+#   max-of-quantiles is the conservative SINGLE-PROCESS read; across a
+#   fleet it would overstate every host but the worst, and averaging
+#   would be meaningless.
+
+_FLEET_QFRAC = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
+
+
+def _fleet_counter_sum(counters: dict, name: str,
+                       only: Optional[Tuple[str, str]] = None) -> float:
+    total = 0.0
+    for s in counters.get(name, ()):
+        if only is not None and s["labels"].get(only[0]) != only[1]:
+            continue
+        total += float(s["value"])
+    return total
+
+
+def _fleet_label_values(counters: dict, name: str, label: str):
+    vals = set()
+    for s in counters.get(name, ()):
+        v = s["labels"].get(label)
+        if v is not None:
+            vals.add(v)
+    return vals
+
+
+def _fleet_quantile(hists: dict, name: str, q: str,
+                    only: Optional[Tuple[str, str]] = None
+                    ) -> Tuple[Optional[float], float]:
+    """(quantile, count) of the merged bucket vectors across the
+    name's matching label series — sums the cumulative vectors first,
+    takes the quantile of the SUM."""
+    merged: Optional[list] = None
+    count = 0.0
+    for s in hists.get(name, ()):
+        if only is not None and s["labels"].get(only[0]) != only[1]:
+            continue
+        cum = s.get("buckets")
+        if not cum:
+            continue
+        count += float(s.get("count", 0))
+        merged = (list(cum) if merged is None
+                  else [a + b for a, b in zip(merged, cum)])
+    if merged is None:
+        return None, count
+    return registry.quantile_from_buckets(
+        merged, _FLEET_QFRAC.get(q, 0.99)), count
+
+
+def _eval_fleet_one(o: Objective, counters: dict, hists: dict,
+                    only: Optional[Tuple[str, str]] = None) -> dict:
+    if o.kind == "ratio":
+        num = _fleet_counter_sum(counters, o.num, only)
+        den = _fleet_counter_sum(counters, o.den, only)
+        ratio = (num / den) if den > 0 else None
+        budget = 1.0 - o.target
+        breached = bool(ratio is not None and budget > 0
+                        and ratio > budget)
+        return {"kind": "ratio", "source": "fleet_lifetime",
+                "num": num, "den": den,
+                "value": None if ratio is None else round(ratio, 6),
+                "budget": round(budget, 6), "breached": breached}
+    value, count = _fleet_quantile(hists, o.hist, o.quantile, only)
+    threshold = o.effective_burn_threshold
+    breached = bool(value is not None and o.threshold
+                    and value / o.threshold >= threshold)
+    return {"kind": "quantile", "source": "merged_buckets",
+            "hist": o.hist, "quantile": o.quantile,
+            "threshold_s": o.threshold,
+            "value": None if value is None else round(value, 9),
+            "samples": count, "breached": breached}
+
+
+def evaluate_fleet(counters: dict, hists: dict,
+                   objectives: Optional[Sequence[Objective]] = None
+                   ) -> dict:
+    """Stateless fleet SLO evaluation over the merged report's
+    ``counters``/``histograms`` sections (knn_tpu.obs.fleet.merge).
+    Grouped objectives expand per label value, ``name:value`` keys like
+    the per-process engine."""
+    objs = load_objectives() if objectives is None else tuple(objectives)
+    out: dict = {"source": "fleet", "objectives": {}}
+    for o in objs:
+        if o.group_by is None:
+            out["objectives"][o.name] = _eval_fleet_one(
+                o, counters, hists)
+            continue
+        surface = o.den if o.kind == "ratio" else None
+        values = (_fleet_label_values(counters, surface, o.group_by)
+                  if surface is not None else
+                  {s["labels"].get(o.group_by)
+                   for s in hists.get(o.hist, ())
+                   if s["labels"].get(o.group_by) is not None})
+        for v in sorted(values):
+            out["objectives"][f"{o.name}:{v}"] = _eval_fleet_one(
+                o, counters, hists, only=(o.group_by, v))
+    out["breached"] = sorted(
+        k for k, e in out["objectives"].items() if e["breached"])
+    return out
+
+
+class FleetSLOEngine:
+    """Edge-triggered breach bookkeeping over successive fleet
+    evaluations (the /fleetz poll loop): :meth:`observe` takes one
+    ``evaluate_fleet`` report and returns the [(key, detail)] list of
+    healthy->breached transitions — exactly one firing per edge, like
+    the per-process engine.  The caller (knn_tpu.obs.fleet.observe)
+    turns each into a ``fleet.alert`` event + a fleet postmortem
+    bundle embedding every member snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._breached: Dict[str, bool] = {}
+
+    def observe(self, fleet_slo: dict) -> list:
+        fired = []
+        with self._lock:
+            for key in sorted(fleet_slo.get("objectives", {})):
+                entry = fleet_slo["objectives"][key]
+                was = self._breached.get(key, False)
+                is_now = bool(entry["breached"])
+                entry["state"] = "breached" if is_now else "healthy"
+                if is_now == was:
+                    continue
+                self._breached[key] = is_now
+                if is_now:
+                    fired.append((key, entry))
+        return fired
+
+    def active_breaches(self):
+        with self._lock:
+            return sorted(n for n, b in self._breached.items() if b)

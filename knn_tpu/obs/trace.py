@@ -31,7 +31,7 @@ import uuid
 from collections import deque
 from typing import Optional
 
-from knn_tpu.obs import names, registry
+from knn_tpu.obs import ident, names, registry
 
 #: env var naming the JSONL sink (unset = in-memory ring only)
 LOG_ENV = "KNN_TPU_OBS_LOG"
@@ -92,8 +92,14 @@ class EventLog:
     def emit(self, event: dict) -> None:
         evt = {"ts": round(time.time(), 6), **event}
         # serialize OUTSIDE the lock: concurrent serving threads must
-        # contend only for the append/write, not for json encoding
-        line = json.dumps(evt) + "\n" if self._path is not None else None
+        # contend only for the append/write, not for json encoding.
+        # FILE lines additionally carry the process identity stamp
+        # (knn_tpu.obs.ident): rotated/merged multi-process logs must
+        # stay attributable to a host, and the fleet trace stitcher
+        # keys cross-host segments off it.  The in-memory ring stays
+        # unstamped — it never leaves the process.
+        line = (json.dumps({**evt, "identity": ident.identity()}) + "\n"
+                if self._path is not None else None)
         with self._lock:
             self._ring.append(evt)
             if line is not None:

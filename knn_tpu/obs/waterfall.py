@@ -271,6 +271,78 @@ def reconstruct(events: Sequence[dict]) -> Dict[str, dict]:
     return out
 
 
+# -- cross-host stitching (knn_tpu.parallel.multihost) ---------------------
+def stitch_multihost(events: Sequence[dict]) -> Dict[str, dict]:
+    """One CROSS-HOST waterfall per request from ``multihost.merge``
+    spans (trace id -> waterfall).  The DCN merge path propagates one
+    canonical trace id through the coordinator-KV exchange and every
+    process emits a ``multihost.merge`` span under it carrying ALL
+    per-host wall times — so a single host's event stream (or N merged
+    JSONL streams) reconstructs the whole replica's request:
+
+    - ``host<h>.local`` — host h's measured local search wall,
+    - ``host<h>.wait``  — host h idle waiting for the straggler
+      (``max(walls) - walls[h]``): the PR 12 straggler gap as explicit
+      per-host segments instead of one max-minus-min scalar,
+    - ``dcn_merge``     — exchange + host-side top-k merge.
+
+    Every lane tiles ``local + wait + dcn_merge`` against the span's
+    measured arrival-to-result total within :func:`tolerance_s`;
+    shortfalls surface as ``unattributed_s``/``overlap_s`` and flip
+    ``complete``, never get absorbed.  When several hosts' streams are
+    merged, the span with the largest measured total is authoritative
+    (its lane saw the full wait)."""
+    by_tid: Dict[str, List[dict]] = {}
+    for e in events:
+        if (e.get("type") == "span" and e.get("span") == "multihost.merge"
+                and e.get("trace_id")):
+            by_tid.setdefault(e["trace_id"], []).append(e)
+    out: Dict[str, dict] = {}
+    for tid, evs in by_tid.items():
+        e = max(evs, key=lambda x: float(x.get("dur_s") or 0.0))
+        walls = [float(w) for w in (e.get("walls_s") or ())]
+        if not walls:
+            continue
+        total = float(e.get("dur_s") or 0.0)
+        max_wall = max(walls)
+        straggler = e.get("straggler_host")
+        if straggler is None:
+            straggler = int(max(range(len(walls)), key=lambda h: walls[h]))
+        merge_s = total - max_wall
+        segments = []
+        for h, w in enumerate(walls):
+            segments.append({"name": f"host{h}.local", "host": h,
+                             "dur_s": round(w, 6)})
+            wait = max_wall - w
+            if wait > 0:
+                segments.append({"name": f"host{h}.wait", "host": h,
+                                 "dur_s": round(wait, 6)})
+        if merge_s > 0:
+            segments.append({"name": "dcn_merge",
+                             "dur_s": round(merge_s, 6)})
+        # every lane sums to max_wall + max(0, merge_s); the residual
+        # against the measured total is stated, never absorbed
+        lane_total = max_wall + max(0.0, merge_s)
+        gap = total - lane_total
+        tol = tolerance_s(total)
+        out[tid] = {
+            "trace_id": tid,
+            "kind": "multihost",
+            "hosts": e.get("hosts", len(walls)),
+            "reporting_host": e.get("host"),
+            "straggler_host": int(straggler),
+            "straggler_gap_s": round(max_wall - min(walls), 6),
+            "total_s": round(total, 6),
+            "segments": segments,
+            "unattributed_s": round(max(0.0, gap), 6),
+            "overlap_s": round(max(0.0, -gap), 6),
+            "tolerance_s": round(tol, 6),
+            "complete": bool(abs(gap) <= tol),
+            "end_ts": e.get("ts"),
+        }
+    return out
+
+
 # -- aggregation -----------------------------------------------------------
 def _percentile(sorted_vals: List[float], q: float) -> float:
     """Nearest-rank percentile over an already-sorted list (numpy-free:
@@ -471,6 +543,7 @@ def live_report(events: Optional[Sequence[dict]] = None) -> dict:
     ``/waterfallz`` serves and a postmortem bundle embeds."""
     evts = trace.get_event_log().recent() if events is None else events
     wfs = reconstruct(evts)
+    stitched = stitch_multihost(evts)
     return {
         "generated_at": time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -479,6 +552,10 @@ def live_report(events: Optional[Sequence[dict]] = None) -> dict:
         "attribution": attribute(wfs),
         "device_vs_roofline": device_vs_roofline(wfs),
         "slowest": slowest_table(events=evts, waterfalls=wfs),
+        # cross-host waterfalls stitched from multihost.merge spans —
+        # absent (None) when no DCN merge ran in this process
+        "multihost": ({"requests": len(stitched), "waterfalls": stitched}
+                      if stitched else None),
     }
 
 

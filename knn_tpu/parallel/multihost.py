@@ -44,6 +44,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from knn_tpu import obs
+from knn_tpu.obs import ident as _ident
 from knn_tpu.obs import names as _mn
 from knn_tpu.parallel import crossover
 from knn_tpu.parallel.mesh import DB_AXIS, QUERY_AXIS, make_mesh
@@ -74,6 +75,15 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+    # stamp the process identity every snapshot / JSONL line carries
+    # (knn_tpu.obs.ident) — the fleet aggregator attributes members by
+    # it.  Only the init args: touching jax.process_index() here could
+    # initialize the local backend earlier than callers expect.
+    stamp = {"process_count": int(num_processes),
+             "coordinator_address": coordinator_address}
+    if process_id is not None:
+        stamp["process_index"] = int(process_id)
+    _ident.set_identity(**stamp)
 
 
 def global_mesh(
@@ -331,29 +341,54 @@ class MultiHostKNN:
             self.dcn_merge, self.dcn_merge_source = None, None
         self._instance = next(_INSTANCE_SEQ)
         self._seq = itertools.count()
+        try:
+            kind = jax.local_devices()[0].device_kind
+        except Exception:  # backendless builds: identity stays honest
+            kind = None
+        _ident.set_identity(process_index=self.process_index,
+                            process_count=self.process_count,
+                            device_kind=kind)
 
     def _local_report(self, wall: float) -> None:
         """Single-process degenerate: no DCN level, but /statusz still
         gets a fresh snapshot (both search paths call this)."""
         _update_report(hosts=1, process_index=0, transport="local",
                        dcn_merge=None, dcn_merge_bytes=0,
-                       straggler_gap_s=0.0,
+                       straggler_gap_s=0.0, straggler_host=0,
                        host_walls_s=[round(wall, 6)])
 
     def _dcn_merge(self, d: np.ndarray, gi: np.ndarray, k: int,
-                   local_wall_s: float, tag: str, extra=()):
+                   local_wall_s: float, tag: str, extra=(),
+                   trace_id: Optional[str] = None,
+                   t_start: Optional[float] = None):
         """Exchange this host's globalized candidate list (+ optional
-        per-host ``extra`` payload arrays) and its local wall time,
-        merge, record the straggler gap (max-min per-host wall — what
-        /statusz attributes) and the DCN volume.  Returns
-        ``(merged_d, merged_gi, info)`` where ``info`` carries the
-        per-process walls, gap, bytes, and each process's extra
-        arrays — ONE exchange/metrics/report home for both search
-        paths."""
+        per-host ``extra`` payload arrays), its local wall time, and
+        its trace id, merge, record the straggler gap (max-min
+        per-host wall — what /statusz attributes, with the argmax host
+        named) and the DCN volume.  Returns ``(merged_d, merged_gi,
+        info)`` where ``info`` carries the per-process walls, gap,
+        straggler host, canonical trace id, bytes, and each process's
+        extra arrays — ONE exchange/metrics/report home for both
+        search paths.
+
+        Trace stitching: each process's trace id rides the same
+        coordinator-KV exchange as the candidate lists, the FIRST
+        non-empty id in process order becomes the request's canonical
+        cross-host id, and every process emits one ``multihost.merge``
+        span under it carrying all per-host walls — so one host's
+        event stream (or N merged streams) reconstructs the cross-host
+        waterfall (knn_tpu.obs.waterfall.stitch_multihost) with the
+        straggler gap as explicit per-host wait segments."""
+        tid_arr = np.frombuffer((trace_id or "").encode("ascii"),
+                                dtype=np.uint8)
         lists = dcn_allgather_arrays(
-            (d, gi, *extra, np.float64(local_wall_s)), tag=tag)
+            (d, gi, *extra, tid_arr, np.float64(local_wall_s)), tag=tag)
         walls = [float(rec[-1]) for rec in lists]
         gap = max(walls) - min(walls)
+        straggler = int(np.argmax(walls))
+        ctid = next(
+            (t for t in (bytes(rec[-2].tobytes()).decode("ascii")
+                         for rec in lists) if t), None)
         md, mi = merge_topk_host([r[0] for r in lists],
                                  [r[1] for r in lists], k)
         bytes_moved = crossover.merge_bytes(
@@ -369,22 +404,42 @@ class MultiHostKNN:
             dcn_merge_source=self.dcn_merge_source,
             dcn_merge_bytes=bytes_moved,
             straggler_gap_s=round(gap, 6),
+            straggler_host=straggler,
             host_walls_s=[round(w, 6) for w in walls],
         )
+        if t_start is not None:
+            obs.record_span(
+                "multihost.merge", ctid,
+                time.perf_counter() - t_start,
+                host=self.process_index,
+                hosts=self.process_count,
+                local_wall_s=round(local_wall_s, 6),
+                walls_s=[round(w, 6) for w in walls],
+                straggler_host=straggler,
+                straggler_gap_s=round(gap, 6),
+                tag=tag,
+            )
         info = {
             "walls_s": walls,
             "straggler_gap_s": gap,
+            "straggler_host": straggler,
+            "trace_id": ctid,
             "bytes": bytes_moved,
-            "extra": [rec[2:-1] for rec in lists],
+            "extra": [rec[2:-2] for rec in lists],
         }
         return md, mi, info
 
     def search(self, queries, *, k: Optional[int] = None,
-               return_sqrt: bool = False):
+               return_sqrt: bool = False,
+               trace_id: Optional[str] = None):
         """Global (distances, indices) [Q, k] over every host's rows —
         bitwise-identical to a single-host ``ShardedKNN.search`` of the
-        concatenated database."""
+        concatenated database.  ``trace_id`` (minted here when absent
+        and telemetry is on) is propagated through the DCN exchange so
+        the cross-host waterfall stitches under one id."""
         k = self.k if k is None else k
+        if trace_id is None:
+            trace_id = obs.new_trace_id()
         t0 = time.perf_counter()
         d, i = self._local.search(queries, k=k)
         d = np.asarray(d)
@@ -393,7 +448,8 @@ class MultiHostKNN:
         if self.process_count > 1:
             d, gi, _ = self._dcn_merge(
                 d, gi, k, wall,
-                f"r{self._instance}/search/{next(self._seq)}")
+                f"r{self._instance}/search/{next(self._seq)}",
+                trace_id=trace_id, t_start=t0)
         else:
             self._local_report(wall)
         if return_sqrt:
@@ -402,7 +458,8 @@ class MultiHostKNN:
             d = np.asarray(metric_values(d, self.metric))
         return d, gi
 
-    def search_certified(self, queries, **kwargs):
+    def search_certified(self, queries, trace_id: Optional[str] = None,
+                         **kwargs):
         """Certified-exact global top-k: each host certifies the exact
         top-k of ITS row block (the full search_certified machinery —
         selector/precision/kernel knobs pass through), then the exact
@@ -410,8 +467,10 @@ class MultiHostKNN:
         block top-k lists IS the exact global top-k, so the
         certification guarantee survives the tree; ``stats`` sums the
         per-host certification counters and carries the straggler
-        gap."""
+        gap (with the argmax host named)."""
         k = self.k
+        if trace_id is None:
+            trace_id = obs.new_trace_id()
         t0 = time.perf_counter()
         d, i, stats = self._local.search_certified(queries, **kwargs)
         wall = time.perf_counter() - t0
@@ -430,7 +489,7 @@ class MultiHostKNN:
             d, gi, info = self._dcn_merge(
                 d, gi, k, wall,
                 f"r{self._instance}/certified/{next(self._seq)}",
-                extra=(counts,))
+                extra=(counts,), trace_id=trace_id, t_start=t0)
             stats = dict(stats)
             stats["per_host"] = {
                 "fallback_queries": [int(e[0][0]) for e in info["extra"]],
@@ -438,6 +497,7 @@ class MultiHostKNN:
                 "walls_s": [round(w, 6) for w in info["walls_s"]],
             }
             stats["straggler_gap_s"] = round(info["straggler_gap_s"], 6)
+            stats["straggler_host"] = info["straggler_host"]
         else:
             self._local_report(wall)
         return d, gi, stats

@@ -53,6 +53,7 @@ if [ "${1:-}" = "--fast" ]; then
     tests/test_join.py \
     tests/test_audit.py \
     tests/test_artifact_schema.py \
+    tests/test_fleet.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "${1:-}" = "--strict" ]; then

@@ -127,6 +127,7 @@ def run_lint(repo) -> int:
                             ("join", "join"),
                             ("quality", "quality"),
                             ("multihost", "multihost"),
+                            ("fleet", "fleet"),
                             ("sentinel", "sentinel verdict")):
             viol = sum(1 for p in problems if p["schema"] == name)
             if not viol:

@@ -185,7 +185,8 @@ def test_version_tokens_resolve_and_are_owned_once():
                       "ivf_version": "ivf",
                       "pq_version": "pq",
                       "join_version": "join",
-                      "quality_version": "quality"}
+                      "quality_version": "quality",
+                      "fleet_version": "fleet"}
 
 
 def test_catalog_refuses_duplicate_version_tokens():

@@ -1,0 +1,390 @@
+"""The fleet observability plane (knn_tpu.obs.fleet): counters sum
+bitwise across members, gauges keep their host, fleet quantiles come
+ONLY from element-wise-summed histogram buckets (never averaged
+percentiles); every degraded mode — unreachable endpoint, torn
+snapshot, stale round, catalog-version skew — produces a LOUD partial
+report with the member listed under ``unreachable``/``skewed`` and
+``cli fleet`` exiting 2; fleet SLO edges fire once and write a
+postmortem bundle embedding every member's snapshot; ``KNN_TPU_OBS=0``
+turns the whole plane off — the acceptance surface of the fleet ISSUE.
+"""
+
+import json
+import os
+
+import pytest
+
+from knn_tpu import obs
+from knn_tpu.analysis import artifacts
+from knn_tpu.cli import main as cli_main
+from knn_tpu.obs import fleet
+from knn_tpu.obs import names as mn
+from knn_tpu.obs import registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Every test starts from an empty ENABLED registry, event ring,
+    identity, and fleet edge state."""
+    obs.reset(enabled=True)
+    obs.reset_event_log(None)
+    obs.ident.reset_identity()
+    fleet.reset_fleet_engine()
+    yield
+    obs.reset()
+    obs.reset_event_log(from_env=True)
+    obs.ident.reset_identity()
+    fleet.reset_fleet_engine()
+
+
+def _write_member(d, fname, pindex, fill, *, host=None):
+    """One member snapshot: a fresh registry stamped as process
+    ``pindex`` with ``fill()``'s metrics, written atomically the way a
+    real member does (export.write_json_snapshot)."""
+    obs.reset(enabled=True)
+    obs.ident.set_identity(host=host or f"h{pindex}",
+                           process_index=pindex, process_count=2,
+                           device_kind="cpu")
+    fill()
+    payload = obs.write_json_snapshot(os.path.join(d, fname))
+    obs.ident.reset_identity()
+    obs.reset(enabled=True)
+    return payload
+
+
+def _two_member_dir(tmp_path, latencies=((0.004,) * 30, (2.5,) * 10)):
+    """The canonical 2-member offline fleet: member 0 serves 5 requests
+    (fast), member 1 serves 7 (slow) — distinct per-host shapes so the
+    merge's per-host attribution is checkable."""
+    d = str(tmp_path / "snaps")
+    os.makedirs(d, exist_ok=True)
+
+    def fill0():
+        obs.counter(mn.SERVING_REQUESTS, op="search").inc(5)
+        obs.gauge(mn.QUEUE_DEPTH_REQUESTS).set(3)
+        h = obs.histogram(mn.SERVING_REQUEST_LATENCY, op="search")
+        for v in latencies[0]:
+            h.observe(v)
+
+    def fill1():
+        obs.counter(mn.SERVING_REQUESTS, op="search").inc(7)
+        obs.gauge(mn.QUEUE_DEPTH_REQUESTS).set(9)
+        h = obs.histogram(mn.SERVING_REQUEST_LATENCY, op="search")
+        for v in latencies[1]:
+            h.observe(v)
+
+    p0 = _write_member(d, "m0.json", 0, fill0)
+    p1 = _write_member(d, "m1.json", 1, fill1)
+    return d, p0, p1
+
+
+# --- merge semantics ------------------------------------------------------
+def test_counters_sum_bitwise_and_gauges_keep_host(tmp_path):
+    d, _, _ = _two_member_dir(tmp_path)
+    rep = fleet.fleet_report(snapshot_dir=d)
+    assert rep["enabled"] and not rep["partial"]
+    assert rep["member_count"] == 2 and rep["expected"] == 2
+    # counters: the fleet served EXACTLY the sum, per-host attribution
+    # intact
+    [c] = rep["counters"][mn.SERVING_REQUESTS]
+    assert c["labels"] == {"op": "search"}
+    assert c["value"] == 12.0
+    assert c["per_host"] == {"h0/0": 5.0, "h1/1": 7.0}
+    # the same member set merges to the bitwise-identical total
+    rep2 = fleet.fleet_report(snapshot_dir=d)
+    [c2] = rep2["counters"][mn.SERVING_REQUESTS]
+    assert c2["value"] == c["value"]
+    # gauges: never averaged — per-host values plus min/max/argmax
+    [g] = rep["gauges"][mn.QUEUE_DEPTH_REQUESTS]
+    assert g["per_host"] == {"h0/0": 3.0, "h1/1": 9.0}
+    assert g["min"] == 3.0 and g["max"] == 9.0 and g["argmax"] == "h1/1"
+
+
+def test_fleet_quantiles_from_merged_buckets_never_averaged(tmp_path):
+    # member 0: 30 fast samples (~4ms); member 1: 10 slow (~2.5s).
+    # 75% of the fleet's samples are fast, so the TRUE fleet p50 is
+    # fast — while the average of the two per-host p50s (~1.25s) is a
+    # number with no operational meaning.
+    d, p0, p1 = _two_member_dir(tmp_path)
+    rep = fleet.fleet_report(snapshot_dir=d)
+    [h] = rep["histograms"][mn.SERVING_REQUEST_LATENCY]
+    assert h["count"] == 40.0
+    fq = h["fleet_quantiles"]
+    assert fq["source"] == "merged_buckets"
+    # p50 lands in the fast mode, p99 in the slow mode (bucket upper
+    # bounds: sound estimates quantized to the shared grid)
+    assert fq["p50"] < 0.1
+    assert fq["p99"] >= 2.5
+    # the unsound merge would have said ~1.25s for p50
+    w0 = h["window_quantiles_per_host"]["h0/0"]
+    w1 = h["window_quantiles_per_host"]["h1/1"]
+    assert abs(fq["p50"] - (w0["p50"] + w1["p50"]) / 2) > 0.5
+    # merged vector is the exact element-wise sum of the members'
+    def _buckets(payload):
+        [s] = payload["metrics"][mn.SERVING_REQUEST_LATENCY]["series"]
+        return s["value"]["buckets"]
+
+    assert h["buckets"] == [a + b for a, b in
+                            zip(_buckets(p0), _buckets(p1))]
+    # fleet p99 brackets both per-host windows from above (it is the
+    # distribution's upper tail, not any single host's)
+    assert fq["p99"] >= max(w0["p99"], w1["p99"]) * 0.99
+
+
+def test_identity_stamps_every_payload_and_keys_the_merge(tmp_path):
+    d, p0, _ = _two_member_dir(tmp_path)
+    # the snapshot itself is stamped (satellite 1)
+    ident = p0["identity"]
+    assert ident["host"] == "h0" and ident["process_index"] == 0
+    assert ident["process_count"] == 2 and "pid" in ident
+    assert ident["catalog_version"] == mn.catalog_version()
+    # and the merge keys members by that stamp
+    rep = fleet.fleet_report(snapshot_dir=d)
+    assert [m["key"] for m in rep["members"]] == ["h0/0", "h1/1"]
+    for m in rep["members"]:
+        assert m["identity"]["catalog_version"] == mn.catalog_version()
+        assert m["written_at_unix"] is not None
+
+
+def test_fleet_gauges_published_and_artifact_block_validates(tmp_path):
+    d, _, _ = _two_member_dir(tmp_path)
+    rep = fleet.fleet_report(snapshot_dir=d)
+    snap = registry.snapshot()
+    assert snap[mn.FLEET_MEMBERS]["series"][0]["value"] == 2.0
+    assert snap[mn.FLEET_UNREACHABLE]["series"][0]["value"] == 0.0
+    assert snap[mn.FLEET_MERGE_STALENESS]["series"][0]["value"] \
+        == rep["staleness_s"]
+    block = fleet.artifact_block(rep)
+    assert artifacts.validate("fleet", block) == []
+    assert block["member_count"] == 2 and block["partial"] is False
+    assert block["fleet_version"] == fleet.FLEET_VERSION
+
+
+# --- degraded modes: loud, never silently narrower ------------------------
+def test_torn_snapshot_listed_unreachable_and_cli_exits_2(tmp_path):
+    d, _, _ = _two_member_dir(tmp_path)
+    with open(os.path.join(d, "m0.json")) as f:
+        good = f.read()
+    with open(os.path.join(d, "torn.json"), "w") as f:
+        f.write(good[: len(good) // 2])  # torn mid-write
+    rep = fleet.fleet_report(snapshot_dir=d)
+    assert rep["partial"] is True
+    assert rep["member_count"] == 2  # the good members still merge
+    [u] = rep["unreachable"]
+    assert u["member"] == "torn.json"
+    assert "JSONDecodeError" in u["reason"]
+    # the merged counter is the sum of the REACHABLE members only,
+    # and the report says so instead of pretending the fleet shrank
+    [c] = rep["counters"][mn.SERVING_REQUESTS]
+    assert c["value"] == 12.0 and rep["expected"] == 3
+    block = fleet.artifact_block(rep)
+    assert block["unreachable_count"] == 1 and block["partial"] is True
+    assert artifacts.validate("fleet", block) == []
+    assert cli_main(["fleet", "--snapshot-dir", d]) == 2
+
+
+def test_unreachable_live_member_degrades_loudly(tmp_path):
+    # a closed port: collection degrades to an error record, never
+    # raises
+    recs = fleet.collect_live(["127.0.0.1:9"], timeout_s=0.3)
+    assert recs[0]["error"] is not None
+    rep = fleet.fleet_report(["127.0.0.1:9"], timeout_s=0.3)
+    assert rep["partial"] is True and rep["member_count"] == 0
+    [u] = rep["unreachable"]
+    assert u["member"] == "127.0.0.1:9"
+    assert cli_main(["fleet", "--members", "127.0.0.1:9",
+                     "--timeout", "0.3"]) == 2
+
+
+def test_stale_snapshot_refused(tmp_path):
+    d, _, _ = _two_member_dir(tmp_path)
+    p = os.path.join(d, "m0.json")
+    with open(p) as f:
+        payload = json.load(f)
+    payload["written_at_unix"] -= 1000.0  # an older collection round
+    with open(p, "w") as f:
+        json.dump(payload, f)
+    rep = fleet.fleet_report(snapshot_dir=d, stale_s=120.0)
+    assert rep["partial"] is True and rep["member_count"] == 1
+    [u] = rep["unreachable"]
+    assert u["member"] == "m0.json" and "stale snapshot" in u["reason"]
+    # the stale member's counters are REFUSED, not silently summed
+    [c] = rep["counters"][mn.SERVING_REQUESTS]
+    assert c["value"] == 7.0 and list(c["per_host"]) == ["h1/1"]
+    assert cli_main(["fleet", "--snapshot-dir", d,
+                     "--stale-s", "120"]) == 2
+
+
+def test_catalog_version_skew_refused(tmp_path):
+    d, _, _ = _two_member_dir(tmp_path)
+    p = os.path.join(d, "m1.json")
+    with open(p) as f:
+        payload = json.load(f)
+    payload["identity"]["catalog_version"] = "deadbeefcafe"
+    with open(p, "w") as f:
+        json.dump(payload, f)
+    rep = fleet.fleet_report(snapshot_dir=d)
+    assert rep["partial"] is True and rep["member_count"] == 1
+    [s] = rep["skewed"]
+    assert s["member"] == "m1.json"
+    assert s["catalog_version"] == "deadbeefcafe"
+    assert s["expected"] == mn.catalog_version()
+    # a skewed member's counters never reach the sum — the meaning of
+    # its names changed between catalog versions
+    [c] = rep["counters"][mn.SERVING_REQUESTS]
+    assert c["value"] == 5.0
+    block = fleet.artifact_block(rep)
+    assert block["skewed_count"] == 1
+    assert registry.snapshot()[mn.FLEET_UNREACHABLE]["series"][0][
+        "value"] == 1.0
+    assert cli_main(["fleet", "--snapshot-dir", d]) == 2
+
+
+def test_cli_fleet_healthy_exit_0_and_json(tmp_path, capsys):
+    # low latencies + zero errors: nothing breaches, nothing partial
+    d, _, _ = _two_member_dir(
+        tmp_path, latencies=((0.004,) * 30, (0.008,) * 10))
+    assert cli_main(["fleet", "--snapshot-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "members merged: 2/2" in out and "PARTIAL" not in out
+    assert "merged buckets" in out
+    assert cli_main(["fleet", "--snapshot-dir", d, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["member_count"] == 2
+    # no source at all: loud usage error, exit 1
+    assert cli_main(["fleet"]) == 1
+
+
+# --- multihost section + offline stitching --------------------------------
+def test_straggler_host_named_and_waterfalls_stitched(tmp_path):
+    d, _, _ = _two_member_dir(tmp_path)
+    # member 1's /statusz carried the replica's multihost section
+    p = os.path.join(d, "m1.json")
+    with open(p) as f:
+        payload = json.load(f)
+    payload["health"] = dict(payload.get("health") or {})
+    payload["health"]["multihost"] = {
+        "host_walls_s": [0.010, 0.030], "straggler_host": 1,
+        "straggler_gap_s": 0.020}
+    with open(p, "w") as f:
+        json.dump(payload, f)
+    # one member's event log carries the cross-host merge spans
+    with open(os.path.join(d, "events.jsonl"), "w") as f:
+        for host in (0, 1):
+            f.write(json.dumps({
+                "type": "span", "span": "multihost.merge",
+                "trace_id": "tid-1", "ts": 100.0, "dur_s": 0.0355,
+                "host": host, "hosts": 2,
+                "walls_s": [0.010, 0.030], "straggler_host": 1,
+                "straggler_gap_s": 0.020}) + "\n")
+    rep = fleet.fleet_report(snapshot_dir=d)
+    mh = rep["multihost"]
+    assert mh["straggler_host"] == 1
+    assert mh["straggler_member"] == "h1/1"  # process 1 named by key
+    assert mh["host_walls_s"] == [0.010, 0.030]
+    # the straggler gauge names the host as a label
+    snap = registry.snapshot()
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap[mn.FLEET_STRAGGLER_HOST]["series"]}
+    assert series[(("host", "h1/1"),)] == 1.0
+    assert series[(("host", "h0/0"),)] == 0.0
+    # the stitched cross-host waterfall tiles: local + wait + dcn_merge
+    # per host, within stated tolerance
+    wf = rep["waterfalls"]["tid-1"]
+    assert wf["kind"] == "multihost" and wf["complete"] is True
+    assert wf["straggler_host"] == 1
+    names_ = [s["name"] for s in wf["segments"]]
+    assert names_ == ["host0.local", "host0.wait", "host1.local",
+                      "dcn_merge"]
+    lane0 = sum(s["dur_s"] for s in wf["segments"]
+                if s.get("host") == 0 or s["name"] == "dcn_merge")
+    assert abs(lane0 - wf["total_s"]) <= wf["tolerance_s"]
+    assert fleet.artifact_block(rep)["stitched_requests"] == 1
+    # the text rendering names the straggler and renders the waterfall
+    txt = fleet.render_text(rep)
+    assert "straggler host1 (h1/1)" in txt
+    assert "stitched cross-host waterfalls: 1" in txt
+
+
+# --- fleet SLO edge + member-embedding postmortems ------------------------
+def test_fleet_slo_edge_fires_once_and_bundle_embeds_members(
+        tmp_path, monkeypatch):
+    pm = str(tmp_path / "pm")
+    monkeypatch.setenv("KNN_TPU_POSTMORTEM_DIR", pm)
+    # 2.5s request latencies: serving_request_p99 (threshold 1.0s)
+    # breaches on the merged buckets
+    d, _, _ = _two_member_dir(tmp_path)
+    rep = fleet.fleet_report(snapshot_dir=d)
+    o = rep["slo"]["objectives"]["serving_request_p99"]
+    assert o["source"] == "merged_buckets" and o["breached"] is True
+    assert "serving_request_p99" in rep["slo"]["breached"]
+    alerts = [e for e in obs.get_event_log().recent()
+              if e.get("name") == "fleet.alert"]
+    assert len(alerts) == 1
+    assert alerts[0]["objective"] == "serving_request_p99"
+    # edge-triggered: the same breach does NOT re-fire
+    fleet.fleet_report(snapshot_dir=d)
+    alerts = [e for e in obs.get_event_log().recent()
+              if e.get("name") == "fleet.alert"]
+    assert len(alerts) == 1
+    bundles = [f for f in os.listdir(pm)
+               if "fleet_serving_request_p99" in f]
+    assert len(bundles) == 1
+    with open(os.path.join(pm, bundles[0])) as f:
+        bundle = json.load(f)
+    assert bundle["kind"] == "fleet"
+    assert bundle["objective"] == "serving_request_p99"
+    # EVERY member's raw snapshot rides in the bundle
+    assert set(bundle["members"]) == {"m0.json", "m1.json"}
+    for rec in bundle["members"].values():
+        assert rec["metrics"] and rec["identity"]
+    assert bundle["fleet"]["member_count"] == 2
+    # the bundle filename matches the flight recorder's pattern, so
+    # retention and `cli waterfall --postmortems` see it
+    from knn_tpu.obs import blackbox
+
+    assert blackbox._FNAME_RE.match(bundles[0])
+
+
+def test_fleet_ratio_objectives_use_lifetime_sums(tmp_path):
+    d = str(tmp_path / "snaps")
+    os.makedirs(d, exist_ok=True)
+
+    def mk(pindex, errors, requests):
+        def fill():
+            obs.counter(mn.SERVING_REQUESTS, op="search").inc(requests)
+            if errors:
+                obs.counter(mn.SERVING_ERRORS, op="search").inc(errors)
+        _write_member(d, f"m{pindex}.json", pindex, fill)
+
+    # 2 errors / 200 requests fleet-wide = 1% > the 0.1% budget — even
+    # though host 0 alone (0/100) looks healthy
+    mk(0, 0, 100)
+    mk(1, 2, 100)
+    rep = fleet.fleet_report(snapshot_dir=d)
+    o = rep["slo"]["objectives"]["serving_availability"]
+    assert o["source"] == "fleet_lifetime"
+    assert o["num"] == 2.0 and o["den"] == 200.0
+    assert o["breached"] is True
+
+
+# --- KNN_TPU_OBS=0: the whole plane off -----------------------------------
+def test_obs_disabled_turns_fleet_plane_off(monkeypatch):
+    monkeypatch.setenv(fleet.MEMBERS_ENV, "127.0.0.1:9")
+    obs.reset(enabled=False)
+    rep = fleet.live_fleet_report()
+    assert rep["enabled"] is False
+    assert "KNN_TPU_OBS=0" in rep["reason"]
+    # no collection happened, no gauges published, and the artifact
+    # block degrades to the loud error shape (validator-exempt)
+    block = fleet.artifact_block(rep)
+    assert block["member_count"] == 0 and "error" in block
+    assert artifacts.validate("fleet", block) == []
+    assert registry.snapshot() == {}
+
+
+def test_unconfigured_live_report_is_loud(monkeypatch):
+    monkeypatch.delenv(fleet.MEMBERS_ENV, raising=False)
+    rep = fleet.live_fleet_report()
+    assert rep["enabled"] is False
+    assert fleet.MEMBERS_ENV in rep["reason"]

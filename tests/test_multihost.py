@@ -506,3 +506,116 @@ def test_serving_engine_over_hierarchical_placement(rng):
     with QueryQueue(eng, max_wait_ms=2.0) as qq:
         d2, i2 = qq.submit(q[:3]).result()
     np.testing.assert_array_equal(np.asarray(i2), np.asarray(ref_i)[:3])
+
+def test_fleet_merges_two_process_telemetry(tmp_path):
+    """ACCEPTANCE (ISSUE 20): the fleet plane over a REAL 2-process
+    jax.distributed lane — each process runs MultiHostKNN searches with
+    telemetry on, logs its ``multihost.merge`` spans to a JSONL sink,
+    and writes an identity-stamped snapshot into a shared directory;
+    the jax-free aggregator then merges offline:
+
+    - merged counters equal the EXACT sum of both members' counters,
+    - the stitched cross-host waterfall tiles (local + wait +
+      dcn_merge per host, within stated tolerance) with the straggler
+      host named,
+    - the bucket-merged fleet p99 brackets both per-host windows
+      (never an average of percentiles).
+
+    Like the KV-lane bitwise test above this needs only distributed
+    INIT, so it is a pinned test on every supported jaxlib."""
+    _require_distributed_init()
+    results = _spawn_jax_procs(tmp_path, """
+        import os, sys, json, time
+        snapdir = os.path.dirname(os.path.abspath(__file__))
+        pid, n_proc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+        os.environ["KNN_TPU_OBS_LOG"] = os.path.join(
+            snapdir, f"events{pid}.jsonl")
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        from knn_tpu import obs
+        from knn_tpu.obs import names as mn
+        from knn_tpu.parallel import multihost
+
+        multihost.initialize(coordinator_address=f"localhost:{port}",
+                             num_processes=n_proc, process_id=pid)
+        rng = np.random.default_rng(0)
+        db = (rng.random((96, 8)) * 10).astype(np.float32)
+        q = (rng.random((6, 8)) * 10).astype(np.float32)
+        rows = 96 // n_proc
+        prog = multihost.MultiHostKNN(
+            db[pid * rows : (pid + 1) * rows], k=5)
+        lat = obs.histogram(mn.SERVING_REQUEST_LATENCY, op="multihost")
+        for _ in range(8):
+            t0 = time.perf_counter()
+            prog.search(q)
+            lat.observe(time.perf_counter() - t0)
+        payload = obs.write_json_snapshot(
+            os.path.join(snapdir, f"member{pid}.json"))
+        [lat_s] = payload["metrics"][
+            mn.SERVING_REQUEST_LATENCY]["series"]
+        out = {
+            "identity": payload["identity"],
+            "merge_bytes": sum(
+                s["value"] for s in
+                payload["metrics"][mn.MERGE_BYTES]["series"]),
+            "window_p95": lat_s["value"]["p95"],
+        }
+        print("RESULT " + json.dumps(out), flush=True)
+    """, n_proc=2)
+
+    # identity stamps: each member is attributable (satellite 1)
+    for pid in (0, 1):
+        ident = results[pid]["identity"]
+        assert ident["process_index"] == pid
+        assert ident["process_count"] == 2
+
+    from knn_tpu.obs import fleet
+    from knn_tpu.obs import names as mn
+
+    fleet.reset_fleet_engine()
+    rep = fleet.fleet_report(snapshot_dir=str(tmp_path))
+    assert rep["enabled"] and not rep["partial"]
+    assert rep["member_count"] == 2
+
+    # merged counters = the EXACT sum of both members'
+    merged_bytes = sum(s["value"]
+                       for s in rep["counters"][mn.MERGE_BYTES])
+    assert merged_bytes == (results[0]["merge_bytes"]
+                            + results[1]["merge_bytes"])
+    per_host_total = sum(v for s in rep["counters"][mn.MERGE_BYTES]
+                         for v in s["per_host"].values())
+    assert per_host_total == merged_bytes
+
+    # bucket-merged fleet p99 brackets BOTH per-host windows: the
+    # merged distribution's upper tail sits at or above every host's
+    # window p95 (8 of 16 samples each), and it came from summed
+    # cumulative buckets — never from averaging percentiles
+    [h] = rep["histograms"][mn.SERVING_REQUEST_LATENCY]
+    assert h["count"] == 16.0
+    fq = h["fleet_quantiles"]
+    assert fq["source"] == "merged_buckets"
+    assert len(h["window_quantiles_per_host"]) == 2
+    for pid in (0, 1):
+        assert fq["p99"] >= results[pid]["window_p95"]
+
+    # the stitched cross-host waterfalls: one per request, each tiling
+    # host-local + wait + dcn_merge against the measured total within
+    # stated tolerance, straggler host named
+    wfs = rep["waterfalls"]
+    assert len(wfs) == 8
+    for wf in wfs.values():
+        assert wf["kind"] == "multihost" and wf["hosts"] == 2
+        assert wf["straggler_host"] in (0, 1)
+        assert wf["complete"], wf
+        lane = sum(
+            s["dur_s"] for s in wf["segments"]
+            if s.get("host") == wf["straggler_host"]
+            or s["name"] == "dcn_merge")
+        assert abs(lane - wf["total_s"]) <= wf["tolerance_s"] + 1e-9
+
+    # the members' /statusz multihost sections agree on the straggler
+    mh = rep["multihost"]
+    assert mh is not None and len(mh["host_walls_s"]) == 2
+    assert mh["straggler_host"] in (0, 1)

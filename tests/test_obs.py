@@ -207,6 +207,12 @@ def test_jsonl_event_log_sink(tmp_path):
     # ids are propagated, never minted inside span(): a span with no
     # request behind it must not fabricate a phantom trace
     assert "trace_id" not in lines[1]
+    # every FILE line carries the process identity stamp (the fleet
+    # aggregator's attribution key) — the in-memory ring does not
+    assert all("identity" in ln for ln in lines)
+    ident = lines[2].pop("identity")
+    assert ident["process_index"] == 0 and "host" in ident
+    assert ident["catalog_version"] == obs.names.catalog_version()
     assert lines[2] == {"ts": lines[2]["ts"], "type": "event",
                         "name": "queue.dispatch", "rows": 4}
 
